@@ -1,0 +1,25 @@
+"""Test environment: force JAX onto CPU with 8 virtual devices so mesh /
+sharding tests run without TPU hardware (SURVEY.md §4 test strategy).
+
+Must run before the first `import jax` anywhere in the test session.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh8():
+    import jax
+    from vnsum_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) == 8
+    return make_mesh({"data": 2, "model": 2, "seq": 2})

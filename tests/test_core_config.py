@@ -1,0 +1,56 @@
+import json
+
+import pytest
+
+from vnsum_tpu.core import PipelineConfig, approach_defaults
+from vnsum_tpu.core.results import DocumentRecord, ModelRunRecord, PipelineResults
+
+
+def test_defaults_match_reference():
+    cfg = PipelineConfig()
+    assert cfg.chunk_size == 12000
+    assert cfg.chunk_overlap == 200
+    assert cfg.token_max == 10000
+    assert cfg.max_context == 16384
+    assert cfg.max_new_tokens == 1024
+
+
+def test_approach_defaults():
+    assert approach_defaults("mapreduce_critique")["max_new_tokens"] == 2048
+    assert approach_defaults("truncated") == {"max_context": 16384}
+    with pytest.raises(ValueError):
+        approach_defaults("nope")
+
+
+def test_roundtrip():
+    cfg = PipelineConfig(approach="iterative", models=["m1"])
+    cfg2 = PipelineConfig.from_dict(json.loads(cfg.to_json()))
+    assert cfg2 == cfg
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        PipelineConfig(approach="bogus")
+    with pytest.raises(ValueError):
+        PipelineConfig(chunk_size=100, chunk_overlap=100)
+    with pytest.raises(ValueError):
+        PipelineConfig.from_dict({"not_a_key": 1})
+
+
+def test_results_schema(tmp_path):
+    res = PipelineResults(config=PipelineConfig().to_dict())
+    rec = ModelRunRecord(model="m", approach="mapreduce")
+    rec.total_documents = 2
+    rec.successful = 2
+    rec.total_chunks = 10
+    rec.total_time = 5.0
+    rec.processing_details.append(
+        DocumentRecord("a.txt", num_chunks=5, processing_time=2.5, summary_length_chars=100)
+    )
+    res.add_summarization(rec)
+    res.add_evaluation("m", {"rouge1": {"f": 0.5}})
+    path = res.save(tmp_path)
+    data = json.loads(path.read_text())
+    assert data["pipeline_info"]["framework"] == "vnsum_tpu"
+    assert data["results"]["summarization"]["m"]["chunks_per_second"] == 2.0
+    assert data["results"]["evaluation"]["m"]["rouge1"]["f"] == 0.5

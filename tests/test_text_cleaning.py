@@ -1,0 +1,33 @@
+from vnsum_tpu.text import clean_thinking_tokens
+
+
+def test_strips_think_block():
+    s = "<think>secret plan</think>Tóm tắt: nội dung chính."
+    assert clean_thinking_tokens(s) == "Tóm tắt: nội dung chính."
+
+
+def test_strips_all_variants_case_insensitive():
+    s = (
+        "<THINKING>a</THINKING>x<Thought>b</Thought>y"
+        "<reasoning>c</reasoning>z<Analysis>d</Analysis>w"
+    )
+    assert clean_thinking_tokens(s) == "xyzw"
+
+
+def test_multiline_blocks_and_whitespace_normalization():
+    s = "A<think>\nline1\nline2\n</think>\n\n\n\nB"
+    assert clean_thinking_tokens(s) == "A\n\nB"
+
+
+def test_empty_and_none_safe():
+    assert clean_thinking_tokens("") == ""
+
+
+def test_collapse_whitespace_variant():
+    s = "a\n\nb\tc"
+    assert clean_thinking_tokens(s, collapse_whitespace=True) == "a b c"
+
+
+def test_unclosed_tag_left_alone():
+    s = "<think>never closed"
+    assert clean_thinking_tokens(s) == "<think>never closed"

@@ -1,0 +1,79 @@
+from vnsum_tpu.text import RecursiveTokenSplitter
+from vnsum_tpu.text.splitter import VIETNAMESE_SEPARATORS
+
+
+def words(text: str) -> int:
+    return len(text.split())
+
+
+def test_no_split_when_fits():
+    sp = RecursiveTokenSplitter(chunk_size=100, chunk_overlap=0, length_function=words)
+    assert sp.split_text("một hai ba") == ["một hai ba"]
+
+
+def test_splits_on_paragraphs_first():
+    text = "câu một dài dài.\n\ncâu hai cũng dài.\n\ncâu ba nữa."
+    sp = RecursiveTokenSplitter(chunk_size=5, chunk_overlap=0, length_function=words)
+    chunks = sp.split_text(text)
+    assert len(chunks) >= 2
+    # nothing lost except whitespace at joins
+    joined = " ".join(chunks)
+    for w in text.split():
+        assert w in joined
+
+
+def test_respects_chunk_size():
+    text = ". ".join(f"câu số {i} có vài từ" for i in range(50))
+    sp = RecursiveTokenSplitter(chunk_size=20, chunk_overlap=0, length_function=words)
+    for c in sp.split_text(text):
+        assert words(c) <= 20
+
+
+def test_overlap_carries_tail():
+    text = "\n\n".join(f"đoạn {i} nội dung dài thêm chữ" for i in range(10))
+    sp = RecursiveTokenSplitter(chunk_size=12, chunk_overlap=6, length_function=words)
+    chunks = sp.split_text(text)
+    assert len(chunks) >= 2
+    # consecutive chunks share at least one word due to overlap
+    for a, b in zip(chunks, chunks[1:]):
+        assert set(a.split()) & set(b.split())
+
+
+def test_oversized_atomic_piece_falls_through_ladder():
+    # a single "word" longer than chunk_size in characters gets split at ""
+    text = "x" * 50
+    sp = RecursiveTokenSplitter(chunk_size=10, chunk_overlap=0, length_function=len)
+    chunks = sp.split_text(text)
+    assert all(len(c) <= 10 for c in chunks)
+    assert "".join(chunks) == text
+
+
+def test_separator_kept_with_following_piece():
+    sp = RecursiveTokenSplitter(chunk_size=3, chunk_overlap=0, length_function=words)
+    chunks = sp.split_text("a b c. d e f. g h i")
+    # the period travels with the following chunk start (langchain
+    # keep_separator=True semantics), minus the strip at joins
+    assert chunks[0] == "a b c"
+    assert chunks[1].startswith(". d") or chunks[1].startswith("d")
+
+
+def test_empty_text():
+    sp = RecursiveTokenSplitter(chunk_size=10, chunk_overlap=0)
+    assert sp.split_text("") == []
+
+
+def test_default_ladder_is_vietnamese():
+    assert VIETNAMESE_SEPARATORS[0] == "\n\n"
+    assert VIETNAMESE_SEPARATORS[-1] == ""
+
+
+def test_token_length_function():
+    from vnsum_tpu.text import ByteTokenizer
+
+    tok = ByteTokenizer()
+    text = "xin chào " * 100
+    sp = RecursiveTokenSplitter(
+        chunk_size=64, chunk_overlap=8, length_function=tok.count
+    )
+    for c in sp.split_text(text):
+        assert tok.count(c) <= 64

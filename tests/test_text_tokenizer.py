@@ -1,0 +1,36 @@
+from vnsum_tpu.text import ByteTokenizer, get_tokenizer, whitespace_token_count
+
+
+def test_byte_roundtrip_vietnamese():
+    tok = ByteTokenizer()
+    s = "Tóm tắt tài liệu tiếng Việt: đầy đủ dấu thanh — ắằẳẵặ."
+    assert tok.decode(tok.encode(s)) == s
+
+
+def test_bos_and_specials():
+    tok = ByteTokenizer()
+    ids = tok.encode("ab", add_bos=True)
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "ab"
+    assert tok.vocab_size % 128 == 0
+    assert len({tok.bos_id, tok.eos_id, tok.pad_id}) == 3
+
+
+def test_count_matches_encode():
+    tok = ByteTokenizer()
+    s = "xin chào việt nam"
+    assert tok.count(s) == len(tok.encode(s))
+
+
+def test_whitespace_count_is_reference_metric():
+    assert whitespace_token_count("một  hai\nba") == 3
+    assert whitespace_token_count("") == 0
+
+
+def test_factory():
+    assert get_tokenizer("byte").vocab_size == 384
+    try:
+        get_tokenizer("nope")
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

@@ -1,0 +1,70 @@
+import json
+
+from vnsum_tpu.text import (
+    DocumentTree,
+    collect_nodes_at_depth,
+    extract_descendant_paragraph_text,
+    replace_node_with_paragraph,
+    tree_depth,
+)
+
+
+def make_tree():
+    return {
+        "type": "Document",
+        "text": "Tài liệu",
+        "children": [
+            {
+                "type": "Header",
+                "text": "Chương 1",
+                "children": [
+                    {"type": "Paragraph", "text": "đoạn 1a"},
+                    {"type": "Paragraph", "text": "đoạn 1b"},
+                ],
+            },
+            {
+                "type": "Header",
+                "text": "Chương 2",
+                "children": [{"type": "Paragraph", "text": "đoạn 2a"}],
+            },
+        ],
+    }
+
+
+def test_depth():
+    assert tree_depth(make_tree()) == 2
+    assert tree_depth({"type": "Paragraph", "text": "x"}) == 0
+
+
+def test_collect_skips_paragraphs():
+    t = make_tree()
+    nodes = collect_nodes_at_depth(t, 1)
+    assert [n["text"] for n in nodes] == ["Chương 1", "Chương 2"]
+    assert collect_nodes_at_depth(t, 2) == []  # depth-2 nodes are Paragraphs
+
+
+def test_extract_paragraph_text_order():
+    assert (
+        extract_descendant_paragraph_text(make_tree())
+        == "đoạn 1a\n\nđoạn 1b\n\nđoạn 2a"
+    )
+
+
+def test_replace_in_place():
+    t = make_tree()
+    node = t["children"][0]
+    replace_node_with_paragraph(node, "tóm tắt chương 1")
+    assert node == {"type": "Paragraph", "text": "tóm tắt chương 1"}
+    assert t["children"][0] is node
+
+
+def test_document_tree_load_and_deepcopy(tmp_path):
+    p = tmp_path / "tree.json"
+    p.write_text(json.dumps({"doc1.txt": make_tree()}), encoding="utf-8")
+    dt = DocumentTree.load(p)
+    assert "doc1.txt" in dt and len(dt) == 1
+    a = dt.get("doc1.txt")
+    replace_node_with_paragraph(a, "mutated")
+    b = dt.get("doc1.txt")
+    assert b["type"] == "Document"  # original untouched
+    assert dt.get("missing.txt") is None

@@ -1,0 +1,27 @@
+"""vnsum_tpu — TPU-native Vietnamese long-document summarization framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+pipeline `Duy1230/Map-Reduced-Approach-for-Vietnamese-Long-Document-
+Summarization` (see SURVEY.md): five summarization strategies (truncated,
+map-reduce, map-reduce + self-critique, iterative refinement, hierarchical
+tree-collapse), a full evaluation stack (ROUGE / BERTScore / semantic
+similarity / G-Eval), and a batch pipeline with resume + structured results —
+all executing against a batched, mesh-sharded on-device generation engine
+instead of serial HTTP calls.
+
+Layer map (mirrors SURVEY.md §1, inverted per §7):
+
+    pipeline/    batch runner, CLI, reports           (ref L6)
+    eval/        metrics, on-device embeddings        (ref L5)
+    strategies/  the five approaches as host drivers  (ref L4+L3)
+    text/        tokenizers, splitter, cleaner, tree  (ref L2)
+    backend/     Backend protocol + generation engine (ref L1)
+    models/      Llama-3.2-3B + encoder in JAX        (new)
+    ops/         Pallas TPU kernels                   (new)
+    parallel/    mesh, shardings, collectives         (new)
+    train/       sharded training step                 (new)
+    data/        datasets, document trees             (ref L0)
+    core/        config, logging, run records
+"""
+
+__version__ = "0.1.0"

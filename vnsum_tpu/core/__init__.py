@@ -1,0 +1,22 @@
+from .config import (
+    ApproachName,
+    EvalConfig,
+    GenerationConfig,
+    PipelineConfig,
+    approach_defaults,
+)
+from .logging import get_logger, setup_run_logging
+from .results import DocumentRecord, ModelRunRecord, PipelineResults
+
+__all__ = [
+    "ApproachName",
+    "EvalConfig",
+    "GenerationConfig",
+    "PipelineConfig",
+    "approach_defaults",
+    "get_logger",
+    "setup_run_logging",
+    "DocumentRecord",
+    "ModelRunRecord",
+    "PipelineResults",
+]

@@ -1,0 +1,158 @@
+"""Typed configuration for the pipeline and strategies.
+
+Mirrors the semantics of the reference's dict-based config
+(run_full_evaluation_pipeline.py:973-1027) — same knob names and defaults —
+but as dataclasses with validation, serialization, and per-approach defaults,
+so every run record embeds the exact config it ran with.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Literal
+
+ApproachName = Literal[
+    "mapreduce",
+    "mapreduce_critique",
+    "iterative",
+    "truncated",
+    "mapreduce_hierarchical",
+]
+
+APPROACHES: tuple[str, ...] = (
+    "mapreduce",
+    "mapreduce_critique",
+    "iterative",
+    "truncated",
+    "mapreduce_hierarchical",
+)
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    """Decoding parameters for one backend.generate() call."""
+
+    max_new_tokens: int = 1024
+    temperature: float = 0.0  # 0.0 => greedy (ref: run_summarization.py:44)
+    top_k: int = 0            # 0 => disabled
+    top_p: float = 1.0
+    eos_ids: tuple[int, ...] = ()
+    seed: int = 0
+
+    def with_(self, **kw) -> "GenerationConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass
+class EvalConfig:
+    """Evaluation stack settings (ref run_full_evaluation_pipeline.py:984-990)."""
+
+    embedding_model: str = "all-MiniLM-L6-v2"
+    include_llm_eval: bool = False
+    use_openrouter: bool = True
+    llm_model: str = "openai/gpt-4o-mini"
+    max_samples: int | None = None
+    bert_batch_size: int = 32
+
+
+@dataclass
+class PipelineConfig:
+    """Full pipeline configuration.
+
+    Defaults follow the reference base_config + per-approach configs
+    (run_full_evaluation_pipeline.py:973-1027); `approach_defaults()` applies
+    the per-approach overrides.
+    """
+
+    approach: str = "mapreduce"
+    models: list[str] = field(default_factory=lambda: ["llama3.2-3b"])
+    backend: str = "tpu"  # tpu | ollama | fake
+    ollama_url: str = "http://localhost:11434"
+    max_new_tokens: int = 1024
+    docs_dir: str = "data_1/doc"
+    summary_dir: str = "data_1/summary"
+    generated_summaries_dir: str = "data_1/generated_summaries"
+    results_dir: str = "evaluation_results"
+    logs_dir: str = "logs"
+    max_samples: int | None = None
+
+    # chunking (mapreduce / critique / hierarchical)
+    chunk_size: int = 12000
+    chunk_overlap: int = 200
+    token_max: int = 10000
+
+    # iterative
+    iterative_chunk_size: int = 12000
+    iterative_chunk_overlap: int = 200
+
+    # truncated
+    max_context: int = 16384
+
+    # critique
+    max_critique_iterations: int = 2
+
+    # hierarchical
+    max_depth: int = 1
+    tree_json_path: str = "data_1/document_tree.json"
+
+    # engine
+    batch_size: int = 8
+    tokenizer: str = "byte"  # byte | hf:<name-or-path>
+    mesh_shape: dict[str, int] = field(default_factory=dict)
+    dtype: str = "bfloat16"
+
+    evaluation: EvalConfig = field(default_factory=EvalConfig)
+
+    def __post_init__(self) -> None:
+        if self.approach not in APPROACHES:
+            raise ValueError(
+                f"unknown approach {self.approach!r}; expected one of {APPROACHES}"
+            )
+        if self.chunk_overlap >= self.chunk_size:
+            raise ValueError("chunk_overlap must be smaller than chunk_size")
+        if self.iterative_chunk_overlap >= self.iterative_chunk_size:
+            raise ValueError(
+                "iterative_chunk_overlap must be smaller than iterative_chunk_size"
+            )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, ensure_ascii=False)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PipelineConfig":
+        d = dict(d)
+        ev = d.pop("evaluation", None)
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra = {k: v for k, v in d.items() if k not in known}
+        if extra:
+            raise ValueError(f"unknown config keys: {sorted(extra)}")
+        cfg = cls(**d)
+        if ev is not None:
+            cfg.evaluation = EvalConfig(**ev) if isinstance(ev, dict) else ev
+        return cfg
+
+
+def approach_defaults(approach: str) -> dict:
+    """Per-approach config overrides, matching the reference's approach_config
+    blocks (run_full_evaluation_pipeline.py:993-1027)."""
+    if approach == "mapreduce":
+        return {"chunk_size": 12000, "chunk_overlap": 200, "token_max": 10000}
+    if approach == "iterative":
+        return {"iterative_chunk_size": 12000, "iterative_chunk_overlap": 200}
+    if approach == "truncated":
+        return {"max_context": 16384}
+    if approach == "mapreduce_critique":
+        return {
+            "chunk_size": 12000,
+            "chunk_overlap": 200,
+            "token_max": 10000,
+            "max_critique_iterations": 2,
+            "max_new_tokens": 2048,
+        }
+    if approach == "mapreduce_hierarchical":
+        return {"chunk_size": 12000, "chunk_overlap": 200, "max_depth": 1}
+    raise ValueError(f"unknown approach: {approach}")

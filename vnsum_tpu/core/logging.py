@@ -1,0 +1,39 @@
+"""Run logging: timestamped file + stdout, like the reference's setup_logging
+(run_full_evaluation_pipeline.py:137-163), without mutating global state twice.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from pathlib import Path
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+
+
+def get_logger(name: str = "vnsum") -> logging.Logger:
+    """Child loggers propagate to the single handler on the "vnsum" root."""
+    root = logging.getLogger("vnsum")
+    if not root.handlers and not logging.getLogger().handlers:
+        h = logging.StreamHandler(sys.stdout)
+        h.setFormatter(logging.Formatter(_FORMAT))
+        root.addHandler(h)
+        root.setLevel(logging.INFO)
+    return logging.getLogger(name)
+
+
+def setup_run_logging(logs_dir: str | Path, run_name: str = "pipeline_run") -> Path:
+    """Attach a timestamped file handler to the root vnsum logger.
+
+    Returns the log file path (logs/<run_name>_<ts>.log).
+    """
+    logs = Path(logs_dir)
+    logs.mkdir(parents=True, exist_ok=True)
+    ts = time.strftime("%Y%m%d_%H%M%S")
+    path = logs / f"{run_name}_{ts}.log"
+    logger = logging.getLogger("vnsum")
+    fh = logging.FileHandler(path, encoding="utf-8")
+    fh.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(fh)
+    logger.setLevel(logging.INFO)
+    return path
