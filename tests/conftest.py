@@ -16,10 +16,20 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _cpu_default_device():
+    # the axon TPU plugin ignores JAX_PLATFORMS=cpu; pin computations to the
+    # host CPU backend (with its 8 forced virtual devices) explicitly
+    import jax
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    yield
+
+
 @pytest.fixture(scope="session")
 def cpu_mesh8():
     import jax
     from vnsum_tpu.parallel.mesh import make_mesh
 
-    assert len(jax.devices()) == 8
-    return make_mesh({"data": 2, "model": 2, "seq": 2})
+    assert len(jax.devices("cpu")) == 8
+    return make_mesh({"data": 2, "model": 2, "seq": 2}, platform="cpu")

@@ -1,0 +1,100 @@
+import numpy as np
+import pytest
+
+from vnsum_tpu.backend import FakeBackend, get_backend
+from vnsum_tpu.core.config import GenerationConfig
+from vnsum_tpu.models import tiny_llama
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from vnsum_tpu.backend.engine import TpuBackend
+
+    return TpuBackend(
+        model_config=tiny_llama(max_seq_len=128),
+        batch_size=4,
+        max_new_tokens=8,
+    )
+
+
+def test_generate_returns_one_string_per_prompt(engine):
+    outs = engine.generate(["xin chào", "tài liệu dài hơn một chút", "a"])
+    assert len(outs) == 3
+    assert all(isinstance(o, str) for o in outs)
+
+
+def test_generate_deterministic(engine):
+    a = engine.generate(["một văn bản"])
+    b = engine.generate(["một văn bản"])
+    assert a == b
+
+
+def test_order_preserved_across_buckets(engine):
+    # 5 prompts, batch_size 4 -> two batches, sorted by length internally
+    prompts = ["aaaa " * 12, "b", "cc", "ddd " * 20, "e"]
+    outs = engine.generate(prompts)
+    # same prompts individually must give identical strings (order mapping ok)
+    for p, o in zip(prompts, outs):
+        assert engine.generate([p])[0] == o
+
+
+def test_batch_padding_invariance(engine):
+    """A prompt's output must not depend on its batch neighbors."""
+    alone = engine.generate(["nội dung cần tóm tắt"])[0]
+    together = engine.generate(
+        ["nội dung cần tóm tắt", "một prompt khác dài hơn hẳn để đổi bucket " * 3]
+    )[0]
+    assert alone == together
+
+
+def test_stats_accumulate(engine):
+    before = engine.stats.prompts
+    engine.generate(["x", "y"])
+    assert engine.stats.prompts == before + 2
+    assert engine.stats.generated_tokens > 0
+    assert engine.stats.batches > 0
+
+
+def test_empty_prompt_list(engine):
+    assert engine.generate([]) == []
+
+
+def test_truncates_overlong_prompt(engine):
+    # max_seq_len 128, max_new 8 -> inputs capped at 120 tokens
+    out = engine.generate(["z" * 1000], max_new_tokens=8)
+    assert isinstance(out[0], str)
+    assert engine.stats.prompt_tokens <= 10_000
+
+
+def test_factory_and_fake():
+    fb = get_backend("fake")
+    assert isinstance(fb, FakeBackend)
+    out = fb.generate(["Tóm tắt:\n<content>\nmột hai ba bốn năm\n</content>"])
+    assert out == ["một hai ba bốn năm"]
+    with pytest.raises(ValueError):
+        get_backend("gpu")
+
+
+def test_fake_scripted():
+    fb = FakeBackend(responses=["r1", "r2"])
+    assert fb.generate(["a"]) == ["r1"]
+    assert fb.generate(["b"]) == ["r2"]
+    with pytest.raises(RuntimeError):
+        fb.generate(["c"])
+
+
+def test_mesh_sharded_generation_matches_single_device():
+    """TP+DP sharded engine must produce identical tokens to unsharded."""
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.parallel import make_mesh
+
+    cfg = tiny_llama(max_seq_len=128)
+    plain = TpuBackend(model_config=cfg, batch_size=4, max_new_tokens=6, seed=3)
+    mesh = make_mesh({"data": 2, "model": 2, "seq": 1}, platform="cpu")
+    sharded = TpuBackend(
+        model_config=cfg, batch_size=4, max_new_tokens=6, mesh=mesh, seed=3
+    )
+    prompts = ["văn bản một", "văn bản thứ hai dài hơn", "ba", "bốn bốn bốn"]
+    np.testing.assert_array_equal(
+        plain.generate(prompts), sharded.generate(prompts)
+    )

@@ -1,0 +1,133 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vnsum_tpu.models import (
+    forward,
+    init_kv_cache,
+    init_params,
+    sample_logits,
+    tiny_llama,
+)
+from vnsum_tpu.models.llama import (
+    decode_attention_mask,
+    prefill_attention_mask,
+    prefill_positions,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama()
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def full_forward_logits(cfg, params, tokens):
+    """No-padding full-sequence forward; cache sized exactly to S."""
+    B, S = tokens.shape
+    cache = init_kv_cache(cfg, B, S)
+    pad = jnp.zeros((B,), jnp.int32)
+    mask = prefill_attention_mask(pad, S, S)
+    pos = prefill_positions(pad, S)
+    logits, _ = forward(params, cfg, tokens, pos, cache, 0, mask)
+    return logits
+
+
+def test_forward_shapes(setup):
+    cfg, params = setup
+    tokens = jnp.arange(12, dtype=jnp.int32).reshape(2, 6) % cfg.vocab_size
+    logits = full_forward_logits(cfg, params, tokens)
+    assert logits.shape == (2, 6, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_causality(setup):
+    """Changing a later token must not affect earlier logits."""
+    cfg, params = setup
+    t1 = jnp.array([[5, 6, 7, 8, 9, 10]], dtype=jnp.int32)
+    t2 = t1.at[0, 5].set(99)
+    l1 = full_forward_logits(cfg, params, t1)
+    l2 = full_forward_logits(cfg, params, t2)
+    np.testing.assert_allclose(l1[0, :5], l2[0, :5], rtol=1e-5)
+    assert not np.allclose(l1[0, 5], l2[0, 5])
+
+
+def test_kv_cache_decode_matches_full_forward(setup):
+    """Incremental decode through the cache == recomputing from scratch."""
+    cfg, params = setup
+    S, extra = 8, 5
+    C = S + extra
+    prompt = jnp.array([list(range(10, 10 + S))], dtype=jnp.int32)
+    pad = jnp.zeros((1,), jnp.int32)
+
+    cache = init_kv_cache(cfg, 1, C)
+    mask = prefill_attention_mask(pad, S, C)
+    pos = prefill_positions(pad, S)
+    logits, cache = forward(params, cfg, prompt, pos, cache, 0, mask)
+    cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    seq = prompt
+    for t in range(extra):
+        seq = jnp.concatenate([seq, cur[:, None]], axis=1)
+        # reference: full forward over the growing sequence
+        ref_logits = full_forward_logits(cfg, params, seq)
+        ref_next = jnp.argmax(ref_logits[:, -1], axis=-1)
+
+        mask_t = decode_attention_mask(pad, S + t, C)
+        pos_t = jnp.array([[S + t]], dtype=jnp.int32)
+        logits, cache = forward(
+            params, cfg, cur[:, None], pos_t, cache, S + t, mask_t
+        )
+        inc_next = jnp.argmax(logits[:, -1], axis=-1)
+        assert int(inc_next[0]) == int(ref_next[0]), f"diverged at step {t}"
+        cur = inc_next.astype(jnp.int32)
+
+
+def test_left_padding_invariance(setup):
+    """A left-padded prompt must produce the same last-token logits as the
+    same prompt unpadded (masks + clipped positions do their job)."""
+    cfg, params = setup
+    ids = [7, 8, 9, 10]
+    S = 8
+    unpadded = jnp.array([ids], dtype=jnp.int32)
+    l_ref = full_forward_logits(cfg, params, unpadded)[0, -1]
+
+    padded = jnp.array([[0] * (S - len(ids)) + ids], dtype=jnp.int32)
+    pad = jnp.array([S - len(ids)], jnp.int32)
+    cache = init_kv_cache(cfg, 1, S)
+    logits, _ = forward(
+        params,
+        cfg,
+        padded,
+        prefill_positions(pad, S),
+        cache,
+        0,
+        prefill_attention_mask(pad, S, S),
+    )
+    np.testing.assert_allclose(np.asarray(l_ref), np.asarray(logits[0, -1]), rtol=2e-4, atol=2e-4)
+
+
+def test_rope_scaling_toggle():
+    cfg_on = tiny_llama(use_llama3_rope_scaling=True, max_seq_len=64)
+    params = init_params(jax.random.key(1), cfg_on)
+    tokens = jnp.ones((1, 4), jnp.int32)
+    out = full_forward_logits(cfg_on, params, tokens)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_sampling_greedy_and_temperature():
+    logits = jnp.array([[0.0, 5.0, 1.0], [3.0, 0.0, -1.0]], jnp.float32)
+    key = jax.random.key(0)
+    greedy = sample_logits(logits, key, temperature=0.0)
+    assert greedy.tolist() == [1, 0]
+    sampled = sample_logits(jnp.tile(logits[:1], (64, 1)), key, temperature=2.0)
+    assert set(np.asarray(sampled).tolist()) - {0, 1, 2} == set()
+    topk = sample_logits(jnp.tile(logits[:1], (64, 1)), key, temperature=5.0, top_k=1)
+    assert set(np.asarray(topk).tolist()) == {1}
+    topp = sample_logits(
+        jnp.tile(logits[:1], (64, 1)), key, temperature=0.5, top_p=0.5
+    )
+    assert set(np.asarray(topp).tolist()) == {1}

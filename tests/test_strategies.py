@@ -1,0 +1,197 @@
+import pytest
+
+from vnsum_tpu.backend import FakeBackend
+from vnsum_tpu.core import PipelineConfig
+from vnsum_tpu.strategies import (
+    HierarchicalStrategy,
+    IterativeStrategy,
+    MapReduceCritiqueStrategy,
+    MapReduceStrategy,
+    TruncatedStrategy,
+    get_strategy,
+    split_by_token_budget,
+)
+from vnsum_tpu.text import RecursiveTokenSplitter
+from vnsum_tpu.text.tokenizer import whitespace_token_count
+
+
+def make_doc(n_paras=30, words_per=40):
+    return "\n\n".join(
+        " ".join(f"từ{p}_{w}" for w in range(words_per)) for p in range(n_paras)
+    )
+
+
+def word_splitter(chunk_size=100, overlap=0):
+    return RecursiveTokenSplitter(
+        chunk_size, overlap, length_function=whitespace_token_count
+    )
+
+
+def test_split_by_token_budget():
+    texts = ["a " * 10, "b " * 10, "c " * 10]
+    groups = split_by_token_budget([t.strip() for t in texts], 20)
+    assert [len(g) for g in groups] == [2, 1]
+    # oversized single text gets its own group
+    groups = split_by_token_budget(["x " * 50, "y"], 20)
+    assert len(groups) == 2
+
+
+def test_mapreduce_single_doc():
+    fb = FakeBackend(summary_words=10)
+    st = MapReduceStrategy(fb, word_splitter(), token_max=1000)
+    doc = make_doc()
+    res = st.summarize(doc)
+    assert res.summary
+    assert res.num_chunks > 1
+    # map prompts contain chunk text; last call is the final reduce
+    assert "tập hợp các bản tóm tắt" in fb.calls[-1]
+
+
+def test_mapreduce_collapse_loop_terminates():
+    # tiny token_max forces collapse rounds; summaries shrink -> terminates
+    fb = FakeBackend(summary_words=30)
+    st = MapReduceStrategy(fb, word_splitter(), token_max=60)
+    res = st.summarize(make_doc(40, 40))
+    assert res.summary
+    assert res.rounds >= 1
+
+
+def test_mapreduce_batch_matches_single():
+    docs = [make_doc(10, 20), make_doc(15, 25)]
+    fb1 = FakeBackend(summary_words=12)
+    st1 = MapReduceStrategy(fb1, word_splitter(), token_max=500)
+    singles = [st1.summarize(d).summary for d in docs]
+    fb2 = FakeBackend(summary_words=12)
+    st2 = MapReduceStrategy(fb2, word_splitter(), token_max=500)
+    batch = [r.summary for r in st2.summarize_batch(docs)]
+    assert batch == singles
+
+
+def test_truncated():
+    fb = FakeBackend(summary_words=8)
+    st = TruncatedStrategy(fb, max_context=200, max_new_tokens=50)
+    doc = "xin chào " * 500
+    res = st.summarize(doc)
+    assert res.num_chunks == 1 and res.llm_calls == 1
+    # prompt was truncated to max_context - max_new_tokens tokens (bytes here)
+    assert len(fb.calls[0].encode()) < 600
+
+
+def test_iterative_sequential_refinement():
+    fb = FakeBackend(summary_words=15)
+    st = IterativeStrategy(fb, word_splitter(50))
+    doc = make_doc(10, 30)
+    res = st.summarize(doc)
+    assert res.num_chunks > 1
+    assert res.rounds == res.num_chunks
+    # first call is the initial prompt, later ones are refine prompts
+    assert "nền tảng" in fb.calls[0]
+    assert "biên tập viên" in fb.calls[1]
+
+
+def test_iterative_batch_lockstep():
+    docs = [make_doc(4, 30), make_doc(8, 30)]
+    fb = FakeBackend(summary_words=15)
+    st = IterativeStrategy(fb, word_splitter(50))
+    rs = st.summarize_batch(docs)
+    assert rs[0].num_chunks < rs[1].num_chunks
+    assert all(r.summary for r in rs)
+
+
+def test_critique_accept_path():
+    # scripted: map x2, reduce, critique says no issues -> no refine, final
+    # reduce + critique accept again
+    fb = FakeBackend(
+        responses=[
+            "tóm tắt 1", "tóm tắt 2",          # map (2 chunks)
+            "tóm tắt cuối", "Không có vấn đề",  # final reduce + critique accept
+        ]
+    )
+    st = MapReduceCritiqueStrategy(fb, word_splitter(50), token_max=1000)
+    doc = make_doc(4, 20)
+    res = st.summarize(doc)
+    assert res.summary == "tóm tắt cuối"
+
+
+def test_critique_refine_path():
+    fb = FakeBackend(
+        responses=[
+            "tóm tắt 1", "tóm tắt 2",
+            "tóm tắt cuối", "Thiếu thông tin về sự kiện X", "tóm tắt đã sửa",
+        ]
+    )
+    st = MapReduceCritiqueStrategy(fb, word_splitter(50), token_max=1000)
+    res = st.summarize(make_doc(4, 20))
+    assert res.summary == "tóm tắt đã sửa"
+    # the refine prompt carried the critique text
+    assert any("sự kiện X" in c for c in fb.calls)
+
+
+def test_critique_iteration_cap_skips_critique():
+    fb = FakeBackend(summary_words=20)
+    st = MapReduceCritiqueStrategy(
+        fb, word_splitter(50), token_max=40, max_critique_iterations=1
+    )
+    res = st.summarize(make_doc(20, 30))
+    assert res.summary
+    assert res.rounds >= 1
+
+
+def make_tree():
+    return {
+        "type": "Document",
+        "text": "Tài liệu",
+        "children": [
+            {
+                "type": "Header",
+                "text": "Chương 1",
+                "children": [
+                    {"type": "Paragraph", "text": "nội dung một " * 30},
+                    {"type": "Paragraph", "text": "nội dung hai " * 30},
+                ],
+            },
+            {
+                "type": "Header",
+                "text": "Chương 2",
+                "children": [{"type": "Paragraph", "text": "nội dung ba " * 30}],
+            },
+        ],
+    }
+
+
+def test_hierarchical_tree_collapse():
+    fb = FakeBackend(summary_words=10)
+    st = HierarchicalStrategy(fb, chunk_size=100, chunk_overlap=0, max_depth=2)
+    tree = make_tree()
+    res = st.summarize_tree(tree)
+    assert res.summary
+    # tree fully collapsed: children all Paragraphs now
+    assert all(c["type"] == "Paragraph" for c in tree["children"])
+    # polish prompt ran last
+    assert "biên tập viên" in fb.calls[-1]
+
+
+def test_hierarchical_plain_text_fallback():
+    fb = FakeBackend(summary_words=10)
+    st = HierarchicalStrategy(fb, chunk_size=100, chunk_overlap=0)
+    res = st.summarize("văn bản thuần túy " * 100)
+    assert res.summary
+
+
+def test_get_strategy_factory():
+    cfg = PipelineConfig()
+    fb = FakeBackend()
+    for name in (
+        "mapreduce", "mapreduce_critique", "iterative", "truncated",
+        "mapreduce_hierarchical",
+    ):
+        st = get_strategy(name, fb, cfg)
+        assert st.name == name
+    with pytest.raises(ValueError):
+        get_strategy("nope", fb, cfg)
+
+
+def test_chunk_clamp_75_percent():
+    fb = FakeBackend()
+    st = HierarchicalStrategy(fb, chunk_size=999999, max_context=1000)
+    assert st.chunk_size == 750

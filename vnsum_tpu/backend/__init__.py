@@ -1,0 +1,15 @@
+from .base import Backend, get_backend
+from .fake import FakeBackend
+from .ollama import OllamaBackend
+
+__all__ = ["Backend", "get_backend", "FakeBackend", "OllamaBackend", "TpuBackend"]
+
+
+def __getattr__(name):
+    # TpuBackend pulls in jax; keep it lazy so host-only tools (cleaners,
+    # token stats, Ollama-backed runs) never pay for it.
+    if name == "TpuBackend":
+        from .engine import TpuBackend
+
+        return TpuBackend
+    raise AttributeError(name)

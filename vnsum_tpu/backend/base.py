@@ -1,0 +1,49 @@
+"""The Backend protocol — the seam the whole framework hangs on.
+
+The reference's equivalent is the OllamaLLM langchain wrapper duplicated five
+times (SURVEY.md §2 C2). Here there is ONE interface, and it is batched:
+`generate` takes a *list* of prompts so strategies can submit every LLM call
+of a round (across chunks and across documents) as one unit. TpuBackend turns
+that into sharded device batches; OllamaBackend loops over HTTP for parity;
+FakeBackend is the deterministic hermetic test double (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+from ..core.config import GenerationConfig
+
+
+@runtime_checkable
+class Backend(Protocol):
+    name: str
+
+    def generate(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+    ) -> list[str]:
+        """Generate one completion per prompt, order-preserving."""
+        ...
+
+    def count_tokens(self, text: str) -> int:
+        ...
+
+
+def get_backend(spec: str, **kwargs) -> Backend:
+    """Factory: "fake", "ollama", or "tpu"."""
+    if spec == "fake":
+        from .fake import FakeBackend
+
+        return FakeBackend(**kwargs)
+    if spec == "ollama":
+        from .ollama import OllamaBackend
+
+        return OllamaBackend(**kwargs)
+    if spec == "tpu":
+        from .engine import TpuBackend
+
+        return TpuBackend(**kwargs)
+    raise ValueError(f"unknown backend {spec!r} (use tpu|ollama|fake)")

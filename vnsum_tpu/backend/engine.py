@@ -1,0 +1,256 @@
+"""TpuBackend — batched, mesh-sharded on-device generation.
+
+This is the component the reference lacks entirely: its map fan-out executes
+serially over HTTP (SURVEY.md §1 "critical architectural observation",
+runners/run_summarization_ollama_mapreduce.py:51-52). Here a list of prompts
+becomes length-bucketed, fixed-shape [B, S] device batches:
+
+- left-padded prompts so prefill's last row and every decode step share one
+  write index across the batch (static shapes, no ragged gather);
+- one jit-compiled prefill + `lax.scan` decode program per (B, S) bucket,
+  cached — bucketing bounds XLA recompiles;
+- greedy or sampled decoding with per-sequence EOS masking inside the scan;
+- params and token batches carry NamedShardings over a (data, model) mesh, so
+  the same program runs single-chip or TP/DP-sharded with GSPMD collectives.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.config import GenerationConfig
+from ..core.logging import get_logger
+from ..models.llama import (
+    LlamaConfig,
+    decode_attention_mask,
+    forward,
+    init_kv_cache,
+    init_params,
+    llama32_3b,
+    prefill_attention_mask,
+    prefill_positions,
+)
+from ..models.sampling import sample_logits
+from ..text.tokenizer import Tokenizer, get_tokenizer
+
+logger = get_logger("vnsum.engine")
+
+_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+
+
+def _bucket_len(n: int, max_len: int) -> int:
+    for b in _BUCKETS:
+        if n <= b and b <= max_len:
+            return b
+    return max_len
+
+
+@dataclass
+class EngineStats:
+    """Wall-clock + token accounting for bench.py / run records."""
+
+    calls: int = 0
+    prompts: int = 0
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    compile_seconds: float = 0.0
+    generate_seconds: float = 0.0
+    batches: int = 0
+    by_bucket: dict = field(default_factory=dict)
+
+    @property
+    def tokens_per_second(self) -> float:
+        total = self.prompt_tokens + self.generated_tokens
+        return total / self.generate_seconds if self.generate_seconds else 0.0
+
+
+class TpuBackend:
+    name = "tpu"
+
+    def __init__(
+        self,
+        model_config: LlamaConfig | None = None,
+        tokenizer: str | Tokenizer = "byte",
+        mesh=None,
+        params=None,
+        batch_size: int = 8,
+        max_new_tokens: int = 1024,
+        generation: GenerationConfig | None = None,
+        seed: int = 0,
+    ) -> None:
+        self.cfg = model_config or llama32_3b()
+        self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
+        self.mesh = mesh
+        self.batch_size = batch_size
+        self.max_new_tokens = max_new_tokens
+        self.gen_cfg = generation or GenerationConfig()
+        if max_new_tokens >= self.cfg.max_seq_len:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} must be < "
+                f"max_seq_len={self.cfg.max_seq_len}"
+            )
+        self.stats = EngineStats()
+        self._fns: dict[tuple[int, int, int], callable] = {}
+        self._seed = seed
+
+        if params is None:
+            t0 = time.time()
+            params = init_params(jax.random.key(seed), self.cfg)
+            logger.info("initialized random params in %.1fs", time.time() - t0)
+        if mesh is not None:
+            from ..parallel.sharding import shard_params
+
+            params = shard_params(params, mesh, self.cfg.tie_embeddings)
+            if batch_size % mesh.shape.get("data", 1):
+                raise ValueError("batch_size must be divisible by mesh data axis")
+        self.params = params
+
+    # -- compiled program per bucket ------------------------------------
+
+    def _make_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig):
+        cfg = self.cfg
+        C = S + max_new
+        eos = jnp.asarray(
+            list(gen.eos_ids) or [self.tok.eos_id], dtype=jnp.int32
+        )
+        pad_id = self.tok.pad_id
+
+        def generate(params, tokens, pad_lens, seed):
+            cache = init_kv_cache(cfg, B, C)
+            positions = prefill_positions(pad_lens, S)
+            mask = prefill_attention_mask(pad_lens, S, C)
+            logits, cache = forward(params, cfg, tokens, positions, cache, 0, mask)
+            key = jax.random.key(seed)
+            key, sub = jax.random.split(key)
+            first = sample_logits(
+                logits[:, -1], sub, gen.temperature, gen.top_k, gen.top_p
+            )
+
+            def step(carry, t):
+                cur, cache, done, key = carry
+                emit = jnp.where(done, pad_id, cur)
+                done = done | jnp.isin(cur, eos)
+                pos = (S - pad_lens) + t
+                mask_t = decode_attention_mask(pad_lens, S + t, C)
+                logits, cache = forward(
+                    params, cfg, cur[:, None], pos[:, None], cache, S + t, mask_t
+                )
+                key, sub = jax.random.split(key)
+                nxt = sample_logits(
+                    logits[:, -1], sub, gen.temperature, gen.top_k, gen.top_p
+                )
+                return (nxt, cache, done, key), emit
+
+            done0 = jnp.zeros((B,), dtype=bool)
+            _, emitted = jax.lax.scan(
+                step, (first, cache, done0, key), jnp.arange(max_new)
+            )
+            return emitted.T  # [B, max_new]
+
+        fn = jax.jit(generate)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.sharding import param_shardings
+
+            ns = lambda spec: NamedSharding(self.mesh, spec)
+            fn = jax.jit(
+                generate,
+                in_shardings=(
+                    param_shardings(self.mesh, cfg.tie_embeddings),
+                    ns(P("data", None)),
+                    ns(P("data")),
+                    None,
+                ),
+                out_shardings=ns(P("data", None)),
+            )
+        return fn
+
+    def _get_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig):
+        key = (B, S, max_new, gen)
+        if key not in self._fns:
+            t0 = time.time()
+            self._fns[key] = self._make_fn(B, S, max_new, gen)
+            logger.info("built generate fn for bucket B=%d S=%d new=%d", B, S, max_new)
+            self.stats.compile_seconds += time.time() - t0
+        return self._fns[key]
+
+    # -- public API ------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+    ) -> list[str]:
+        gen = config or self.gen_cfg
+        max_new = max_new_tokens or (
+            config.max_new_tokens if config else self.max_new_tokens
+        )
+        if max_new >= self.cfg.max_seq_len:
+            raise ValueError(
+                f"max_new_tokens={max_new} must be < max_seq_len={self.cfg.max_seq_len}"
+            )
+        if not prompts:
+            return []
+
+        self.stats.calls += 1
+        self.stats.prompts += len(prompts)
+
+        max_input = self.cfg.max_seq_len - max_new
+        encoded: list[list[int]] = []
+        for p in prompts:
+            ids = self.tok.encode(p, add_bos=True)
+            if len(ids) > max_input:
+                ids = ids[:max_input]
+            encoded.append(ids)
+            self.stats.prompt_tokens += len(ids)
+
+        # group indices by bucketed length, then emit fixed-shape batches
+        order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
+        results: list[str | None] = [None] * len(encoded)
+        t0 = time.time()
+        data_size = self.mesh.shape.get("data", 1) if self.mesh is not None else 1
+        for start in range(0, len(order), self.batch_size):
+            group = order[start : start + self.batch_size]
+            S = _bucket_len(
+                max(len(encoded[i]) for i in group), max_input
+            )
+            # bucket the batch dim too, so a trailing partial group doesn't
+            # pay for all-pad rows up to the full batch_size
+            B = data_size
+            while B < len(group):
+                B *= 2
+            B = min(B, self.batch_size)
+            tokens = np.full((B, S), self.tok.pad_id, dtype=np.int32)
+            pad_lens = np.full((B,), S, dtype=np.int32)
+            for row, i in enumerate(group):
+                ids = encoded[i]
+                tokens[row, S - len(ids) :] = ids  # left padding
+                pad_lens[row] = S - len(ids)
+            fn = self._get_fn(B, S, max_new, gen)
+            out = np.asarray(fn(self.params, tokens, pad_lens, self._seed))
+            self.stats.batches += 1
+            self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
+            for row, i in enumerate(group):
+                results[i] = self._detok(out[row])
+        self.stats.generate_seconds += time.time() - t0
+        return results  # type: ignore[return-value]
+
+    def _detok(self, ids: np.ndarray) -> str:
+        self.stats.generated_tokens += int((ids != self.tok.pad_id).sum())
+        out: list[int] = []
+        for t in ids.tolist():
+            if t == self.tok.eos_id or t == self.tok.pad_id:
+                break
+            out.append(t)
+        return self.tok.decode(out).strip()
+
+    def count_tokens(self, text: str) -> int:
+        return self.tok.count(text)

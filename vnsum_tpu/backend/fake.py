@@ -1,0 +1,58 @@
+"""Deterministic fake backend for hermetic strategy tests (SURVEY.md §4:
+the reference has no test double at all — every run needs a live Ollama).
+
+Two modes:
+- extractive (default): return the first `summary_words` words of the longest
+  <content>-like region of the prompt — deterministic, content-dependent, and
+  shrinking, so collapse loops terminate the way real summarization does;
+- scripted: pop canned responses in order (for critique accept-paths etc.).
+"""
+from __future__ import annotations
+
+import re
+
+from ..core.config import GenerationConfig
+from ..text.tokenizer import whitespace_token_count
+
+_BLOCK = re.compile(
+    r"<(?:content|summary|docs|reference_content|critique)>\n?(.*?)\n?</(?:content|summary|docs|reference_content|critique)>",
+    re.DOTALL,
+)
+
+
+class FakeBackend:
+    name = "fake"
+
+    def __init__(
+        self,
+        responses: list[str] | None = None,
+        summary_words: int = 40,
+        prefix: str = "",
+    ) -> None:
+        self._responses = list(responses) if responses else None
+        self.summary_words = summary_words
+        self.prefix = prefix
+        self.calls: list[str] = []
+
+    def _one(self, prompt: str) -> str:
+        if self._responses is not None:
+            if not self._responses:
+                raise RuntimeError("FakeBackend ran out of scripted responses")
+            return self._responses.pop(0)
+        blocks = _BLOCK.findall(prompt)
+        source = max(blocks, key=len) if blocks else prompt
+        words = source.split()
+        return self.prefix + " ".join(words[: self.summary_words])
+
+    def generate(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+    ) -> list[str]:
+        self.calls.extend(prompts)
+        return [self._one(p) for p in prompts]
+
+    def count_tokens(self, text: str) -> int:
+        return whitespace_token_count(text)

@@ -1,0 +1,93 @@
+"""Ollama HTTP backend — behavioral port of the reference's OllamaLLM
+(runners/run_summarization_ollama_mapreduce.py:37-60, with the drifted copies'
+fixes folded in: `think: false` from ..._critique.py:63-79, the 600 s timeout
+from ..._hierarchical.py:64-65, and thinking-token cleaning from
+run_full_evaluation_pipeline.py:66-117).
+
+Kept as an alternate backend behind the same interface (BASELINE.json:
+`--backend=tpu|ollama`). Unlike the reference's fake-async `_acall`
+(...mapreduce.py:51-52), batches here run over a thread pool, so a
+multi-worker Ollama server actually sees concurrent requests.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+from ..core.config import GenerationConfig
+from ..text.cleaning import clean_thinking_tokens
+from ..text.tokenizer import whitespace_token_count
+
+
+class OllamaBackend:
+    name = "ollama"
+
+    def __init__(
+        self,
+        model: str = "llama3.2:3b",
+        url: str = "http://localhost:11434",
+        max_new_tokens: int = 1024,
+        timeout: float = 600.0,
+        clean_output: bool = True,
+        concurrency: int = 4,
+    ) -> None:
+        self.model = model
+        self.url = url.rstrip("/")
+        self.max_new_tokens = max_new_tokens
+        self.timeout = timeout
+        self.clean_output = clean_output
+        self.concurrency = concurrency
+
+    def health_check(self) -> list[str]:
+        """GET /api/tags; returns available model names
+        (ref run_full_evaluation_pipeline.py:199-233)."""
+        import requests
+
+        resp = requests.get(f"{self.url}/api/tags", timeout=10)
+        resp.raise_for_status()
+        return [m["name"] for m in resp.json().get("models", [])]
+
+    def _one(self, prompt: str, max_new: int, config: GenerationConfig | None) -> str:
+        import requests
+
+        options: dict = {"num_predict": max_new}
+        if config is not None:
+            options["temperature"] = config.temperature
+            if config.top_k > 0:
+                options["top_k"] = config.top_k
+            if config.top_p < 1.0:
+                options["top_p"] = config.top_p
+            if config.seed:
+                options["seed"] = config.seed
+        payload = {
+            "model": self.model,
+            "prompt": prompt,
+            "stream": False,
+            "think": False,
+            "options": options,
+        }
+        resp = requests.post(
+            f"{self.url}/api/generate", json=payload, timeout=self.timeout
+        )
+        resp.raise_for_status()
+        text = resp.json()["response"]
+        return clean_thinking_tokens(text) if self.clean_output else text
+
+    def generate(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+    ) -> list[str]:
+        max_new = max_new_tokens or (
+            config.max_new_tokens if config else self.max_new_tokens
+        )
+        if len(prompts) == 1:
+            return [self._one(prompts[0], max_new, config)]
+        with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+            return list(pool.map(lambda p: self._one(p, max_new, config), prompts))
+
+    def count_tokens(self, text: str) -> int:
+        """Whitespace estimate, matching OllamaLLM.get_num_tokens
+        (...mapreduce.py:58-60) for collapse-gating parity."""
+        return whitespace_token_count(text)

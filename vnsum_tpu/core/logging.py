@@ -22,18 +22,27 @@ def get_logger(name: str = "vnsum") -> logging.Logger:
     return logging.getLogger(name)
 
 
+_active_file_handler: logging.FileHandler | None = None
+
+
 def setup_run_logging(logs_dir: str | Path, run_name: str = "pipeline_run") -> Path:
-    """Attach a timestamped file handler to the root vnsum logger.
+    """Attach a timestamped file handler to the root vnsum logger, replacing
+    the handler from any previous run in this process.
 
     Returns the log file path (logs/<run_name>_<ts>.log).
     """
+    global _active_file_handler
     logs = Path(logs_dir)
     logs.mkdir(parents=True, exist_ok=True)
     ts = time.strftime("%Y%m%d_%H%M%S")
     path = logs / f"{run_name}_{ts}.log"
     logger = logging.getLogger("vnsum")
+    if _active_file_handler is not None:
+        logger.removeHandler(_active_file_handler)
+        _active_file_handler.close()
     fh = logging.FileHandler(path, encoding="utf-8")
     fh.setFormatter(logging.Formatter(_FORMAT))
     logger.addHandler(fh)
     logger.setLevel(logging.INFO)
+    _active_file_handler = fh
     return path

@@ -1,0 +1,21 @@
+from .llama import (
+    LlamaConfig,
+    forward,
+    init_kv_cache,
+    init_params,
+    llama32_1b,
+    llama32_3b,
+    tiny_llama,
+)
+from .sampling import sample_logits
+
+__all__ = [
+    "LlamaConfig",
+    "forward",
+    "init_kv_cache",
+    "init_params",
+    "llama32_1b",
+    "llama32_3b",
+    "tiny_llama",
+    "sample_logits",
+]

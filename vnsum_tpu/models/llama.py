@@ -1,0 +1,268 @@
+"""Llama-3.2 family in functional JAX, designed for the MXU.
+
+This fills the architectural slot of the reference's LLM execution layer (the
+OllamaLLM HTTP wrapper, runners/run_summarization_ollama_mapreduce.py:23-60,
+and the torch path in runners/run_summarization.py:54-62) with an on-device
+implementation:
+
+- params are a plain pytree with a stacked leading layer dim, so the decoder
+  runs as one `lax.scan` over layers (fast XLA compiles, clean TP shardings);
+- GQA attention with RoPE (llama3 frequency scaling), RMSNorm, SwiGLU;
+- a preallocated KV cache written with `lax.dynamic_update_slice` so prefill
+  and single-token decode share one code path and static shapes;
+- bfloat16 storage/matmuls with float32 softmax and norms.
+
+No HF/torch code is used on the compute path; weights can be randomly
+initialized (benchmarks, tests) or converted from safetensors offline.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 3072
+    n_layers: int = 28
+    n_heads: int = 24
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate: int = 8192
+    rope_theta: float = 500_000.0
+    use_llama3_rope_scaling: bool = True
+    rope_scale_factor: float = 32.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_len: int = 8192
+    norm_eps: float = 1e-5
+    max_seq_len: int = 16_384
+    tie_embeddings: bool = True
+    dtype: Any = field(default=jnp.bfloat16)
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+def llama32_3b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama32_1b(**kw) -> LlamaConfig:
+    base = dict(
+        dim=2048, n_layers=16, n_heads=32, n_kv_heads=8, head_dim=64,
+        intermediate=8192,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def tiny_llama(**kw) -> LlamaConfig:
+    """Small config for hermetic CPU tests."""
+    base = dict(
+        vocab_size=384, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=16, intermediate=128, max_seq_len=256,
+        use_llama3_rope_scaling=False, rope_theta=10_000.0,
+        dtype=jnp.float32,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+# -- parameters -------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Random init; layer weights are stacked on a leading L dim."""
+    L, D, H, KV, hd, I = (
+        cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+        cfg.intermediate,
+    )
+    keys = iter(jax.random.split(key, 16))
+
+    def norm(shape, k, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    params = {
+        "embed": norm((cfg.vocab_size, D), next(keys)),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "wq": norm((L, D, H, hd), next(keys)),
+            "wk": norm((L, D, KV, hd), next(keys)),
+            "wv": norm((L, D, KV, hd), next(keys)),
+            "wo": norm((L, H, hd, D), next(keys)),
+            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+            "w_gate": norm((L, D, I), next(keys)),
+            "w_up": norm((L, D, I), next(keys)),
+            "w_down": norm((L, I, D), next(keys)),
+        },
+        "final_norm": jnp.ones((D,), cfg.dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm((D, cfg.vocab_size), next(keys))
+    return params
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, cache_len: int) -> dict:
+    shape = (cfg.n_layers, batch, cache_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+# -- building blocks --------------------------------------------------------
+
+
+def _rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w
+
+
+def _rope_inv_freq(cfg: LlamaConfig) -> jax.Array:
+    half = cfg.head_dim // 2
+    inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if not cfg.use_llama3_rope_scaling:
+        return inv
+    # llama3 long-context frequency scaling: low-frequency bands divided by
+    # `factor`, high-frequency bands kept, smooth ramp between.
+    lo_wavelen = cfg.rope_original_max_len / cfg.rope_low_freq_factor
+    hi_wavelen = cfg.rope_original_max_len / cfg.rope_high_freq_factor
+    wavelen = 2.0 * jnp.pi / inv
+    ramp = (cfg.rope_original_max_len / wavelen - cfg.rope_low_freq_factor) / (
+        cfg.rope_high_freq_factor - cfg.rope_low_freq_factor
+    )
+    ramp = jnp.clip(ramp, 0.0, 1.0)
+    scaled = inv / cfg.rope_scale_factor
+    smooth = (1.0 - ramp) * scaled + ramp * inv
+    out = jnp.where(wavelen > lo_wavelen, scaled, inv)
+    between = (wavelen <= lo_wavelen) & (wavelen >= hi_wavelen)
+    return jnp.where(between, smooth, out)
+
+
+def _rope_cos_sin(cfg: LlamaConfig, positions: jax.Array):
+    """positions [B, S] -> cos/sin [B, S, hd/2] (float32)."""
+    angles = positions[..., None].astype(jnp.float32) * _rope_inv_freq(cfg)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [B, S, H, hd]; rotate-half convention (pairs are [..:half],[half:..])."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = x1f * c - x2f * s
+    out2 = x2f * c + x1f * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def _attention(
+    q: jax.Array,        # [B, S, H, hd]
+    k: jax.Array,        # [B, C, KV, hd]
+    v: jax.Array,        # [B, C, KV, hd]
+    mask: jax.Array,     # [B, S, C] bool — True = attend
+    q_per_kv: int,
+) -> jax.Array:
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    qg = q.reshape(B, S, KV, q_per_kv, hd)
+    scores = jnp.einsum(
+        "bskgh,bckh->bkgsc", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    neg = jnp.finfo(jnp.float32).min
+    scores = jnp.where(mask[:, None, None, :, :], scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgsc,bckh->bskgh", probs, v)
+    return out.reshape(B, S, H, hd)
+
+
+def _block(x, lp, cos, sin, mask, k_cache, v_cache, write_index, cfg: LlamaConfig):
+    """One decoder layer. k_cache/v_cache are this layer's [B, C, KV, hd]."""
+    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, write_index, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, write_index, 0, 0))
+
+    attn = _attention(q, k_cache, v_cache, mask, cfg.q_per_kv)
+    attn_out = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    x = x + attn_out
+
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,di->bsi", h, lp["w_gate"])
+    up = jnp.einsum("bsd,di->bsi", h, lp["w_up"])
+    mlp_out = jnp.einsum("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+    return x + mlp_out, k_cache, v_cache
+
+
+def forward(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,       # [B, S] int32
+    positions: jax.Array,    # [B, S] int32 (RoPE positions, pad rows clipped)
+    kv_cache: dict,          # {"k","v": [L, B, C, KV, hd]}
+    write_index,             # scalar: cache slot of tokens[:, 0]
+    mask: jax.Array,         # [B, S, C] bool over cache slots
+    *,
+    remat: bool = False,
+) -> tuple[jax.Array, dict]:
+    """Run the decoder; returns (logits [B, S, vocab] f32, updated cache)."""
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = _rope_cos_sin(cfg, positions)
+
+    block = _block
+    if remat:
+        block = jax.checkpoint(_block, static_argnums=(8,))
+
+    def layer_step(carry, xs):
+        h = carry
+        lp, k_c, v_c = xs
+        h, k_c, v_c = block(h, lp, cos, sin, mask, k_c, v_c, write_index, cfg)
+        return h, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, head, preferred_element_type=jnp.float32
+    )
+    return logits, {"k": new_k, "v": new_v}
+
+
+# -- mask / position helpers (host-independent, shape-static) ----------------
+
+
+def prefill_attention_mask(pad_lens: jax.Array, seq_len: int, cache_len: int):
+    """Left-padded causal mask: query i attends cache slot j iff
+    pad_b <= j <= i. [B, S, C]."""
+    i = jnp.arange(seq_len)[None, :, None]
+    j = jnp.arange(cache_len)[None, None, :]
+    pad = pad_lens[:, None, None]
+    return (j >= pad) & (j <= i)
+
+
+def decode_attention_mask(pad_lens: jax.Array, fill: jax.Array, cache_len: int):
+    """Single-token step: attend j iff pad_b <= j <= fill. [B, 1, C]."""
+    j = jnp.arange(cache_len)[None, None, :]
+    pad = pad_lens[:, None, None]
+    return (j >= pad) & (j <= fill)
+
+
+def prefill_positions(pad_lens: jax.Array, seq_len: int) -> jax.Array:
+    """RoPE positions for left-padded prompts: max(0, i - pad). [B, S]."""
+    i = jnp.arange(seq_len)[None, :]
+    return jnp.maximum(0, i - pad_lens[:, None])

@@ -1,0 +1,39 @@
+"""Token sampling under jit: greedy, temperature, top-k, top-p.
+
+Greedy matches the reference's do_sample=False baseline
+(runners/run_summarization.py:44); Ollama's default sampling is approximated
+by temperature/top-k/top-p knobs (GenerationConfig).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(
+    logits: jax.Array,      # [B, V] float32
+    key: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Returns sampled token ids [B]. temperature==0 -> argmax (greedy)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits / jnp.float32(temperature)
+
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min, logits)
+
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob > top_p; keep at least one token
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, jnp.finfo(jnp.float32).min, logits)
+
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,12 @@
+from .mesh import MeshAxes, make_mesh, mesh_from_spec
+from .sharding import batch_spec, param_shardings, param_specs, shard_params
+
+__all__ = [
+    "MeshAxes",
+    "make_mesh",
+    "mesh_from_spec",
+    "batch_spec",
+    "param_shardings",
+    "param_specs",
+    "shard_params",
+]

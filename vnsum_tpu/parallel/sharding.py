@@ -1,0 +1,68 @@
+"""PartitionSpec trees for model state (megatron-style tensor parallelism).
+
+Weights are sharded on the head / hidden dimensions over the `model` axis;
+batches over `data`. GSPMD inserts the all-gathers / reduce-scatters over ICI
+— nothing here issues a collective by hand (scaling-book recipe; contrast
+SURVEY.md §2.2: the reference has no parallelism to port).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .mesh import AXES
+
+_D, _M = AXES.data, AXES.model
+
+
+def param_specs(tie_embeddings: bool = True) -> dict[str, Any]:
+    """PartitionSpec pytree matching models.llama param structure.
+
+    Layer leaves carry a leading stacked-layer dim (scanned), hence the
+    leading None in every layer spec.
+    """
+    specs = {
+        "embed": P(_M, None),          # vocab-sharded embedding
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, _M, None),   # [L, D, nh, hd] — heads sharded
+            "wk": P(None, None, _M, None),
+            "wv": P(None, None, _M, None),
+            "wo": P(None, _M, None, None),   # [L, nh, hd, D]
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, _M),     # [L, D, I] — hidden sharded
+            "w_up": P(None, None, _M),
+            "w_down": P(None, _M, None),     # [L, I, D]
+        },
+        "final_norm": P(None),
+    }
+    if not tie_embeddings:
+        specs["lm_head"] = P(None, _M)       # [D, V]
+    return specs
+
+
+def cache_specs() -> dict[str, Any]:
+    """KV cache [L, B, C, kv_heads, hd]: batch over data, heads over model."""
+    return {"k": P(None, _D, None, _M, None), "v": P(None, _D, None, _M, None)}
+
+
+def batch_spec() -> P:
+    """[B, S] token batches shard over data."""
+    return P(_D, None)
+
+
+def param_shardings(mesh: Mesh, tie_embeddings: bool = True) -> dict[str, Any]:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(tie_embeddings),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True) -> Any:
+    """Place a param pytree onto the mesh with TP shardings."""
+    shardings = param_shardings(mesh, tie_embeddings)
+    return jax.tree.map(jax.device_put, params, shardings)

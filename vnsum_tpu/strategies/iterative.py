@@ -1,0 +1,76 @@
+"""Iterative refinement strategy.
+
+Semantics follow runners/run_summarization_ollama_iterative.py:102-210: the
+first chunk seeds a foundation summary, then each subsequent chunk triggers a
+full rewrite integrating the new information. Per document the chain is
+inherently sequential, so batching happens ACROSS documents: round r submits
+chunk r of every document that still has one as a single backend batch.
+"""
+from __future__ import annotations
+
+from ..backend.base import Backend
+from ..text.splitter import RecursiveTokenSplitter
+from .base import StrategyResult, _BatchCounter, register_strategy
+from .prompts import ITERATIVE_INITIAL, ITERATIVE_REFINE
+
+
+@register_strategy
+class IterativeStrategy:
+    name = "iterative"
+
+    def __init__(
+        self,
+        backend: Backend,
+        splitter: RecursiveTokenSplitter,
+        max_new_tokens: int | None = None,
+    ) -> None:
+        self.backend = backend
+        self.splitter = splitter
+        self.max_new_tokens = max_new_tokens
+
+    @classmethod
+    def from_config(cls, backend: Backend, config, **kw):
+        splitter = RecursiveTokenSplitter(
+            config.iterative_chunk_size,
+            config.iterative_chunk_overlap,
+            length_function=backend.count_tokens,
+        )
+        return cls(backend, splitter, max_new_tokens=config.max_new_tokens, **kw)
+
+    def summarize_batch(self, docs: list[str]) -> list[StrategyResult]:
+        gen = _BatchCounter(self.backend, self.max_new_tokens)
+        chunks_per_doc = [self.splitter.split_text(d) or [d] for d in docs]
+        summaries = [""] * len(docs)
+        max_rounds = max(len(c) for c in chunks_per_doc) if docs else 0
+
+        for r in range(max_rounds):
+            idx = [di for di, c in enumerate(chunks_per_doc) if r < len(c)]
+            if r == 0:
+                prompts = [
+                    ITERATIVE_INITIAL.format(context=chunks_per_doc[di][0])
+                    for di in idx
+                ]
+            else:
+                prompts = [
+                    ITERATIVE_REFINE.format(
+                        existing_answer=summaries[di],
+                        context=chunks_per_doc[di][r],
+                    )
+                    for di in idx
+                ]
+            outs = gen(prompts)
+            for di, out in zip(idx, outs):
+                summaries[di] = out
+
+        return [
+            StrategyResult(
+                summary=summaries[di],
+                num_chunks=len(chunks_per_doc[di]),
+                llm_calls=gen.calls,
+                rounds=len(chunks_per_doc[di]),
+            )
+            for di in range(len(docs))
+        ]
+
+    def summarize(self, doc: str) -> StrategyResult:
+        return self.summarize_batch([doc])[0]

@@ -88,14 +88,19 @@ class HFTokenizer:
 
         self._tok = AutoTokenizer.from_pretrained(name_or_path)
         self.vocab_size = len(self._tok)
-        self.bos_id = self._tok.bos_token_id or 0
-        self.eos_id = self._tok.eos_token_id or 0
+        if self._tok.eos_token_id is None:
+            raise ValueError(
+                f"tokenizer {name_or_path!r} has no eos token; the engine "
+                "needs one to terminate generation"
+            )
+        self.eos_id = self._tok.eos_token_id
+        self.bos_id = self._tok.bos_token_id  # may be None (no BOS prepended)
         pad = self._tok.pad_token_id
         self.pad_id = pad if pad is not None else self.eos_id
 
     def encode(self, text: str, *, add_bos: bool = False) -> list[int]:
         ids = self._tok.encode(text, add_special_tokens=False)
-        if add_bos:
+        if add_bos and self.bos_id is not None:
             ids = [self.bos_id] + ids
         return ids
 
