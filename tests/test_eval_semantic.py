@@ -1,0 +1,119 @@
+import numpy as np
+import pytest
+
+from vnsum_tpu.eval import EmbeddingModel, SemanticEvaluator, bert_scores
+from vnsum_tpu.eval.geval import LLMJudge, _parse_score
+from vnsum_tpu.models.encoder import tiny_encoder
+
+
+@pytest.fixture(scope="module")
+def embedder():
+    return EmbeddingModel(config=tiny_encoder(), max_len=64, batch_size=4)
+
+
+def test_identical_texts_similarity_one(embedder):
+    embs = embedder.sentence_embeddings(["văn bản a", "văn bản a"])
+    assert np.dot(embs[0], embs[1]) == pytest.approx(1.0, abs=1e-5)
+    assert np.linalg.norm(embs[0]) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_bert_score_identical_is_one(embedder):
+    scores = bert_scores(embedder, ["một hai ba"], ["một hai ba"])
+    assert scores[0].f1 == pytest.approx(1.0, abs=1e-5)
+    assert scores[0].precision == pytest.approx(scores[0].recall, abs=1e-5)
+
+
+def test_bert_score_empty_text_is_finite(embedder):
+    for cand, ref in [("some text", ""), ("", "ref text"), ("", "")]:
+        s = bert_scores(embedder, [cand], [ref])[0]
+        assert np.isfinite(s.precision) and np.isfinite(s.recall)
+        assert np.isfinite(s.f1)
+
+
+def test_bert_score_differs_for_different_texts(embedder):
+    same = bert_scores(embedder, ["một hai ba"], ["một hai ba"])[0].f1
+    diff = bert_scores(embedder, ["một hai ba"], ["bốn năm sáu bảy tám"])[0].f1
+    assert diff < same
+
+
+def test_evaluator_end_to_end(tmp_path, embedder):
+    gen = tmp_path / "gen"
+    ref = tmp_path / "ref"
+    gen.mkdir()
+    ref.mkdir()
+    for i in range(3):
+        (gen / f"d{i}.txt").write_text(f"tóm tắt văn bản số {i}", encoding="utf-8")
+        (ref / f"d{i}.txt").write_text(f"văn bản tham chiếu số {i}", encoding="utf-8")
+    (ref / "unpaired.txt").write_text("x", encoding="utf-8")
+
+    ev = SemanticEvaluator(embedding_model=embedder)
+    out = tmp_path / "results.json"
+    results = ev.evaluate_folders(gen, ref, output=out)
+
+    stats = results["summary_statistics"]
+    assert set(stats) >= {"semantic_similarity", "rouge_scores", "bert_scores"}
+    assert len(results["detailed_results"]) == 3
+    assert all(0 <= d["rouge1_f"] <= 1 for d in results["detailed_results"])
+    assert out.exists()
+
+
+def test_evaluator_max_samples(tmp_path, embedder):
+    gen = tmp_path / "g"
+    ref = tmp_path / "r"
+    gen.mkdir()
+    ref.mkdir()
+    for i in range(5):
+        (gen / f"d{i}.txt").write_text("a b c", encoding="utf-8")
+        (ref / f"d{i}.txt").write_text("a b d", encoding="utf-8")
+    ev = SemanticEvaluator(embedding_model=embedder)
+    results = ev.evaluate_pairs(
+        {f"d{i}.txt": "a" for i in range(5)},
+        {f"d{i}.txt": "a" for i in range(5)},
+        max_samples=2,
+    )
+    assert len(results["detailed_results"]) == 2
+
+
+def test_evaluator_no_overlap_raises(embedder):
+    ev = SemanticEvaluator(embedding_model=embedder)
+    with pytest.raises(ValueError):
+        ev.evaluate_pairs({"a.txt": "x"}, {"b.txt": "y"})
+
+
+def test_geval_score_parsing():
+    assert _parse_score('{"score": 4, "reason": "ok"}') == pytest.approx(0.75)
+    assert _parse_score("Score: 1") == pytest.approx(0.0)
+    assert _parse_score("5") == pytest.approx(1.0)
+    assert _parse_score("no score here 9000") is None
+
+
+def test_geval_with_fake_backend():
+    from vnsum_tpu.backend import FakeBackend
+
+    fb = FakeBackend(responses=['{"score": 5}', '{"score": 3}'] * 2)
+    judge = LLMJudge(backend=fb)
+    stats = judge.evaluate(
+        {"a.txt": "tóm tắt", "b.txt": "tóm tắt b"},
+        {"a.txt": "tham chiếu", "b.txt": "tham chiếu b"},
+    )
+    assert stats["llm_successful_cases"] == 2
+    assert stats["llm_failed_cases"] == 0
+    assert stats["llm_correctness_mean"] == pytest.approx(1.0)
+    assert stats["llm_coherence_mean"] == pytest.approx(0.5)
+
+
+def test_geval_contains_failures():
+    from vnsum_tpu.backend import FakeBackend
+
+    fb = FakeBackend(responses=["garbage", "garbage", '{"score": 5}', '{"score": 5}'])
+    judge = LLMJudge(backend=fb)
+    stats = judge.evaluate(
+        {"a.txt": "x", "b.txt": "y"}, {"a.txt": "x", "b.txt": "y"}
+    )
+    assert stats["llm_failed_cases"] == 1
+    assert stats["llm_successful_cases"] == 1
+
+
+def test_judge_requires_target():
+    with pytest.raises(ValueError):
+        LLMJudge()

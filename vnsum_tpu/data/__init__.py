@@ -1,0 +1,3 @@
+from .dataset import DocumentDataset, DocStats, analyze_documents
+
+__all__ = ["DocumentDataset", "DocStats", "analyze_documents"]

@@ -1,0 +1,17 @@
+from .embedding import BertScore, EmbeddingModel, bert_scores, cosine_similarities
+from .geval import LLMJudge
+from .rouge import RougeScorer, Score, tokenize
+from .semantic import SemanticEvaluator, load_summary_dir
+
+__all__ = [
+    "BertScore",
+    "EmbeddingModel",
+    "bert_scores",
+    "cosine_similarities",
+    "LLMJudge",
+    "RougeScorer",
+    "Score",
+    "tokenize",
+    "SemanticEvaluator",
+    "load_summary_dir",
+]

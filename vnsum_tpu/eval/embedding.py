@@ -1,0 +1,138 @@
+"""On-device embedding metrics: sentence cosine similarity and BERTScore.
+
+Replaces the reference's sentence-transformers per-pair encode loop
+(evaluate/evaluate_summaries_semantic.py:561-575 — re-encodes every pair,
+no batching) and the external bert-score package (:577-582) with batched
+JAX passes over one encoder.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.encoder import (
+    EncoderConfig,
+    encode,
+    init_encoder_params,
+    mean_pool,
+    minilm_like,
+)
+from ..text.tokenizer import Tokenizer, get_tokenizer
+
+
+@dataclass(frozen=True)
+class BertScore:
+    precision: float
+    recall: float
+    f1: float
+
+
+class EmbeddingModel:
+    """Tokenize → encode on device, with fixed-length batches."""
+
+    def __init__(
+        self,
+        config: EncoderConfig | None = None,
+        tokenizer: str | Tokenizer = "byte",
+        params=None,
+        max_len: int | None = None,
+        batch_size: int = 32,
+        seed: int = 0,
+    ) -> None:
+        self.cfg = config or minilm_like()
+        self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
+        self.max_len = max_len or self.cfg.max_len
+        self.batch_size = batch_size
+        self.params = params if params is not None else init_encoder_params(
+            jax.random.key(seed), self.cfg
+        )
+        self._encode = jax.jit(partial(encode, cfg=self.cfg))
+
+    def _batch_tokens(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        S = self.max_len
+        toks = np.full((len(texts), S), self.tok.pad_id, dtype=np.int32)
+        mask = np.zeros((len(texts), S), dtype=bool)
+        for i, t in enumerate(texts):
+            ids = self.tok.encode(t)[:S]
+            toks[i, : len(ids)] = ids
+            mask[i, : len(ids)] = True
+        return toks, mask
+
+    def token_embeddings(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (embs [N, S, D], mask [N, S]) in fixed-size batches."""
+        embs, masks = [], []
+        for start in range(0, len(texts), self.batch_size):
+            chunk = texts[start : start + self.batch_size]
+            # pad the trailing partial batch to the full batch size so the
+            # jitted encode sees one shape
+            toks, mask = self._batch_tokens(
+                chunk + [""] * (self.batch_size - len(chunk))
+            )
+            out = np.asarray(self._encode(self.params, tokens=toks, mask=mask))
+            embs.append(out[: len(chunk)])
+            masks.append(mask[: len(chunk)])
+        return np.concatenate(embs), np.concatenate(masks)
+
+    def sentence_embeddings(self, texts: list[str]) -> np.ndarray:
+        """L2-normalized mean-pooled embeddings [N, D]."""
+        embs, mask = self.token_embeddings(texts)
+        return np.asarray(mean_pool(jnp.asarray(embs), jnp.asarray(mask)))
+
+
+def cosine_similarities(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Row-wise cosine of two [N, D] arrays (already normalized or not)."""
+    an = a / np.maximum(np.linalg.norm(a, axis=-1, keepdims=True), 1e-9)
+    bn = b / np.maximum(np.linalg.norm(b, axis=-1, keepdims=True), 1e-9)
+    return np.sum(an * bn, axis=-1)
+
+
+@jax.jit
+def _greedy_match(c_embs, c_mask, r_embs, r_mask):
+    """BERTScore greedy matching for one pair batch:
+    c_embs [N, Sc, D], r_embs [N, Sr, D] -> (P, R) [N]."""
+    cn = c_embs / jnp.maximum(
+        jnp.linalg.norm(c_embs, axis=-1, keepdims=True), 1e-9
+    )
+    rn = r_embs / jnp.maximum(
+        jnp.linalg.norm(r_embs, axis=-1, keepdims=True), 1e-9
+    )
+    sim = jnp.einsum("ncd,nrd->ncr", cn, rn)
+    valid = c_mask[:, :, None] & r_mask[:, None, :]
+    sim = jnp.where(valid, sim, -jnp.inf)
+    c_best = jnp.max(sim, axis=2)  # [N, Sc]
+    r_best = jnp.max(sim, axis=1)  # [N, Sr]
+    # tokens with no valid counterpart (empty other side) contribute 0, and
+    # padding contributes 0 — keeps empty texts finite instead of -inf/NaN
+    c_best = jnp.where(c_mask & jnp.isfinite(c_best), c_best, 0.0)
+    r_best = jnp.where(r_mask & jnp.isfinite(r_best), r_best, 0.0)
+    c_count = jnp.maximum(jnp.sum(c_mask, axis=1), 1)
+    r_count = jnp.maximum(jnp.sum(r_mask, axis=1), 1)
+    P = jnp.sum(c_best, axis=1) / c_count
+    R = jnp.sum(r_best, axis=1) / r_count
+    return P, R
+
+
+def bert_scores(
+    model: EmbeddingModel, candidates: list[str], references: list[str]
+) -> list[BertScore]:
+    """Corpus BERTScore (no IDF weighting, like bert_score defaults the
+    reference relies on at evaluate/evaluate_summaries_semantic.py:577-582)."""
+    if len(candidates) != len(references):
+        raise ValueError("candidates and references must align")
+    if not candidates:
+        return []
+    c_embs, c_mask = model.token_embeddings(candidates)
+    r_embs, r_mask = model.token_embeddings(references)
+    P, R = _greedy_match(
+        jnp.asarray(c_embs), jnp.asarray(c_mask), jnp.asarray(r_embs), jnp.asarray(r_mask)
+    )
+    P, R = np.asarray(P), np.asarray(R)
+    out = []
+    for p, r in zip(P.tolist(), R.tolist()):
+        f1 = 2 * p * r / (p + r) if (p + r) else 0.0
+        out.append(BertScore(p, r, f1))
+    return out

@@ -1,0 +1,153 @@
+"""LLM-judge G-Eval (correctness vs reference, coherence standalone).
+
+Port of the reference's DeepEval + OpenRouter path
+(evaluate/evaluate_summaries_semantic.py:203-433) without the deepeval
+dependency: the judge prompt asks for a 1-5 rating which is normalized to
+0-1 like G-Eval does; criteria texts are verbatim (:275-300). Works against
+any OpenAI-compatible chat endpoint, or a local Backend for offline judging.
+Per-case failures are contained (:318-376) so one bad call never voids a run.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from ..core.logging import get_logger
+
+logger = get_logger("vnsum.geval")
+
+CORRECTNESS_CRITERIA = """
+        Correctness (1-5): Measures how accurately the generated summary captures the key information and main points from the reference summary.
+        Criteria:
+        - How much correct information does the generated summary contain compare to the reference summary?
+        - Does the generated summay contains contradictions with the source document?
+        - How well does the generated summary cover key points and main themes (or events) with respect to the reference?
+        """
+
+COHERENCE_CRITERIA = """
+        Coherence (1-5): Measures the logical flow, structure, and organization of the generated summary.
+        The summary should:
+        - Have a clear and logical structure that flows from sentence to sentence
+        - Be well-organized with coherent progression of ideas
+        - Maintain consistency in style and tone throughout
+        - Not be just a collection of random facts, but a cohesive narrative
+        - Use appropriate transitions and connections between concepts
+        """
+
+_JUDGE_TEMPLATE = """You are an expert evaluator of text summaries.
+
+Evaluation criteria:
+{criteria}
+
+{body}
+
+Respond with ONLY a JSON object: {{"score": <number 1-5>, "reason": "<short reason>"}}
+"""
+
+_SCORE_RE = re.compile(r'"score"\s*:\s*([0-9.]+)')
+
+
+def _parse_score(text: str) -> float | None:
+    m = _SCORE_RE.search(text)
+    if not m:
+        m = re.search(r"\b([1-5](?:\.\d+)?)\b", text)
+    if not m:
+        return None
+    raw = float(m.group(1))
+    if not 1.0 <= raw <= 5.0:
+        return None
+    return (raw - 1.0) / 4.0  # normalize 1-5 -> 0-1 like G-Eval
+
+
+class LLMJudge:
+    """Judge over a Backend-protocol generator (local) or an OpenAI-compatible
+    HTTP endpoint (set api_base/api_key/model, e.g. OpenRouter)."""
+
+    def __init__(
+        self,
+        backend=None,
+        api_base: str | None = None,
+        api_key: str | None = None,
+        model: str = "openai/gpt-4o-mini",
+        max_new_tokens: int = 256,
+    ) -> None:
+        if backend is None and api_base is None:
+            raise ValueError("LLMJudge needs a local backend or an api_base")
+        self.backend = backend
+        self.api_base = api_base.rstrip("/") if api_base else None
+        self.api_key = api_key
+        self.model = model
+        self.max_new_tokens = max_new_tokens
+
+    def _complete(self, prompts: list[str]) -> list[str]:
+        if self.backend is not None:
+            return self.backend.generate(prompts, max_new_tokens=self.max_new_tokens)
+        import requests
+
+        outs = []
+        for p in prompts:
+            resp = requests.post(
+                f"{self.api_base}/chat/completions",
+                headers={"Authorization": f"Bearer {self.api_key}"},
+                json={
+                    "model": self.model,
+                    "messages": [{"role": "user", "content": p}],
+                    "max_tokens": self.max_new_tokens,
+                },
+                timeout=120,
+            )
+            resp.raise_for_status()
+            outs.append(resp.json()["choices"][0]["message"]["content"])
+        return outs
+
+    def evaluate(
+        self, generated: dict[str, str], references: dict[str, str]
+    ) -> dict:
+        """Returns the llm_scores stats block of the results schema."""
+        files = sorted(set(generated) & set(references))
+        correctness: list[float] = []
+        coherence: list[float] = []
+        failed = 0
+        for fname in files:
+            try:
+                corr_prompt = _JUDGE_TEMPLATE.format(
+                    criteria=CORRECTNESS_CRITERIA,
+                    body=(
+                        f"Generated summary:\n{generated[fname]}\n\n"
+                        f"Reference summary:\n{references[fname]}"
+                    ),
+                )
+                coh_prompt = _JUDGE_TEMPLATE.format(
+                    criteria=COHERENCE_CRITERIA,
+                    body=f"Generated summary:\n{generated[fname]}",
+                )
+                corr_out, coh_out = self._complete([corr_prompt, coh_prompt])
+                c1, c2 = _parse_score(corr_out), _parse_score(coh_out)
+                if c1 is None or c2 is None:
+                    raise ValueError("judge returned no parseable score")
+                correctness.append(c1)
+                coherence.append(c2)
+            except Exception as e:  # per-case containment (ref :373-376)
+                failed += 1
+                logger.warning("G-Eval failed for %s: %s", fname, e)
+
+        def _stats(prefix: str, vals: list[float]) -> dict:
+            if not vals:
+                return {f"{prefix}_mean": 0.0, f"{prefix}_std": 0.0,
+                        f"{prefix}_min": 0.0, f"{prefix}_max": 0.0}
+            return {
+                f"{prefix}_mean": float(np.mean(vals)),
+                f"{prefix}_std": float(np.std(vals)),
+                f"{prefix}_min": float(np.min(vals)),
+                f"{prefix}_max": float(np.max(vals)),
+            }
+
+        return {
+            **_stats("llm_correctness", correctness),
+            **_stats("llm_coherence", coherence),
+            "llm_successful_cases": len(correctness),
+            "llm_failed_cases": failed,
+            "llm_total_cases_processed": len(files),
+        }

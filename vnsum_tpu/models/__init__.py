@@ -9,6 +9,16 @@ from .llama import (
 )
 from .sampling import sample_logits
 
+# model name -> config factory (names match the reference's Ollama tags where
+# an equivalent open-weights architecture exists)
+MODEL_REGISTRY = {
+    "llama3.2:3b": llama32_3b,
+    "llama3.2-3b": llama32_3b,
+    "llama3.2:1b": llama32_1b,
+    "llama3.2-1b": llama32_1b,
+    "tiny": tiny_llama,
+}
+
 __all__ = [
     "LlamaConfig",
     "forward",

@@ -1,0 +1,130 @@
+"""Bidirectional transformer encoder in functional JAX.
+
+Fills the slot of the reference's sentence-transformers MiniLM + multilingual
+BERT (evaluate/evaluate_summaries_semantic.py:128-133, :150-166): one encoder
+architecture serves both the sentence-embedding cosine metric (mean pooling)
+and the BERTScore token-embedding pass — batched on device instead of
+per-pair host encodes (the reference re-encodes every pair serially, :561-575).
+
+Same stacked-layer + lax.scan design as models.llama; weights random-init by
+default (metrics are then self-consistent rather than pretrained-calibrated)
+or converted offline from a HF checkpoint.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 384
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    intermediate: int = 1024
+    max_len: int = 512
+    norm_eps: float = 1e-12
+    dtype: Any = field(default=jnp.float32)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def minilm_like(**kw) -> EncoderConfig:
+    """Shape-compatible with all-MiniLM-L6-v2 (6 layers, 384 dim)."""
+    base = dict(dim=384, n_layers=6, n_heads=12, intermediate=1536)
+    base.update(kw)
+    return EncoderConfig(**base)
+
+
+def tiny_encoder(**kw) -> EncoderConfig:
+    base = dict(dim=64, n_layers=2, n_heads=4, intermediate=128, max_len=128)
+    base.update(kw)
+    return EncoderConfig(**base)
+
+
+def init_encoder_params(key: jax.Array, cfg: EncoderConfig) -> dict:
+    L, D, I = cfg.n_layers, cfg.dim, cfg.intermediate
+    ks = iter(jax.random.split(key, 12))
+
+    def norm(shape, k, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    return {
+        "tok_embed": norm((cfg.vocab_size, D), next(ks)),
+        "pos_embed": norm((cfg.max_len, D), next(ks)),
+        "embed_norm": {"w": jnp.ones((D,), cfg.dtype), "b": jnp.zeros((D,), cfg.dtype)},
+        "layers": {
+            "wq": norm((L, D, D), next(ks)),
+            "wk": norm((L, D, D), next(ks)),
+            "wv": norm((L, D, D), next(ks)),
+            "wo": norm((L, D, D), next(ks)),
+            "attn_norm_w": jnp.ones((L, D), cfg.dtype),
+            "attn_norm_b": jnp.zeros((L, D), cfg.dtype),
+            "w_up": norm((L, D, I), next(ks)),
+            "b_up": jnp.zeros((L, I), cfg.dtype),
+            "w_down": norm((L, I, D), next(ks)),
+            "b_down": jnp.zeros((L, D), cfg.dtype),
+            "mlp_norm_w": jnp.ones((L, D), cfg.dtype),
+            "mlp_norm_b": jnp.zeros((L, D), cfg.dtype),
+        },
+    }
+
+
+def _layernorm(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def encode(
+    params: dict, cfg: EncoderConfig, tokens: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """tokens [B, S] int32, mask [B, S] bool -> token embeddings [B, S, D]."""
+    B, S = tokens.shape
+    x = jnp.take(params["tok_embed"], tokens, axis=0)
+    x = x + params["pos_embed"][None, :S]
+    x = _layernorm(x, params["embed_norm"]["w"], params["embed_norm"]["b"], cfg.norm_eps)
+
+    attn_mask = mask[:, None, None, :]  # [B, 1, 1, S] keys
+    H, hd = cfg.n_heads, cfg.head_dim
+
+    def layer_step(x, lp):
+        q = (x @ lp["wq"]).reshape(B, S, H, hd)
+        k = (x @ lp["wk"]).reshape(B, S, H, hd)
+        v = (x @ lp["wv"]).reshape(B, S, H, hd)
+        scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.where(attn_mask, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, cfg.dim)
+        x = _layernorm(
+            x + attn @ lp["wo"], lp["attn_norm_w"], lp["attn_norm_b"], cfg.norm_eps
+        )
+        h = jax.nn.gelu(x @ lp["w_up"] + lp["b_up"])
+        x = _layernorm(
+            x + h @ lp["w_down"] + lp["b_down"],
+            lp["mlp_norm_w"],
+            lp["mlp_norm_b"],
+            cfg.norm_eps,
+        )
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    return x
+
+
+def mean_pool(token_embs: jax.Array, mask: jax.Array) -> jax.Array:
+    """Masked mean pooling + L2 normalize -> sentence embeddings [B, D]."""
+    m = mask[..., None].astype(token_embs.dtype)
+    summed = jnp.sum(token_embs * m, axis=1)
+    counts = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    pooled = summed / counts
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)

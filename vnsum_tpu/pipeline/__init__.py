@@ -1,0 +1,3 @@
+from .runner import PipelineRunner
+
+__all__ = ["PipelineRunner"]

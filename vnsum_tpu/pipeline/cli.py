@@ -1,0 +1,80 @@
+"""CLI entry point, flag-compatible with the reference's argparse surface
+(run_full_evaluation_pipeline.py:956-970) plus the TPU-era knobs
+(--backend, --mesh, --tokenizer, --batch-size per BASELINE.json).
+"""
+from __future__ import annotations
+
+import argparse
+
+from ..core.config import APPROACHES, PipelineConfig, approach_defaults
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="vnsum-pipeline",
+        description="Run the summarization evaluation pipeline",
+    )
+    p.add_argument("--approach", choices=APPROACHES, default="mapreduce")
+    p.add_argument(
+        "--models", nargs="+", default=["llama3.2:3b"],
+        help="Models to evaluate (TPU backend: names in MODEL_REGISTRY)",
+    )
+    p.add_argument("--max-samples", type=int, default=None)
+    p.add_argument("--tree-json", default="data_1/document_tree.json")
+    p.add_argument("--max-depth", type=int, default=1)
+    p.add_argument("--backend", choices=["tpu", "ollama", "fake"], default="tpu")
+    p.add_argument("--ollama-url", default="http://localhost:11434")
+    p.add_argument("--docs-dir", default="data_1/doc")
+    p.add_argument("--summary-dir", default="data_1/summary")
+    p.add_argument("--generated-summaries-dir", default="data_1/generated_summaries")
+    p.add_argument("--results-dir", default="evaluation_results")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--tokenizer", default="byte", help="byte or hf:<name-or-path>")
+    p.add_argument(
+        "--mesh", default="", help='device mesh, e.g. "data=2,model=4"'
+    )
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> PipelineConfig:
+    overrides = approach_defaults(args.approach)
+    mesh_shape = {}
+    if args.mesh:
+        for part in args.mesh.split(","):
+            k, v = part.split("=")
+            mesh_shape[k.strip()] = int(v)
+    cfg = PipelineConfig(
+        approach=args.approach,
+        models=list(args.models),
+        backend=args.backend,
+        ollama_url=args.ollama_url,
+        docs_dir=args.docs_dir,
+        summary_dir=args.summary_dir,
+        generated_summaries_dir=args.generated_summaries_dir,
+        results_dir=args.results_dir,
+        max_samples=args.max_samples,
+        batch_size=args.batch_size,
+        tokenizer=args.tokenizer,
+        mesh_shape=mesh_shape,
+        tree_json_path=args.tree_json,
+        max_depth=args.max_depth,
+        **{
+            k: v
+            for k, v in overrides.items()
+            if k not in ("max_depth", "tree_json_path")
+        },
+    )
+    return cfg
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from .runner import PipelineRunner
+
+    runner = PipelineRunner(config_from_args(args))
+    runner.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

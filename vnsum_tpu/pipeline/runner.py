@@ -1,0 +1,337 @@
+"""Batch evaluation pipeline.
+
+Mirrors the reference PipelineRunner's flow (run_full_evaluation_pipeline.py:
+120-947): preflight → document analysis → per-model summarization with
+resume-by-file → per-model evaluation → report → structured results JSON —
+with the reference's process boundaries removed: evaluation runs in-process
+(no subprocess + stdout scraping, :649-784), and summarization submits
+document batches to the strategy layer so all per-round LLM calls share
+device batches.
+"""
+from __future__ import annotations
+
+import time
+import traceback
+from pathlib import Path
+
+from ..backend.base import Backend, get_backend
+from ..core.config import PipelineConfig
+from ..core.logging import get_logger, setup_run_logging
+from ..core.results import DocumentRecord, ModelRunRecord, PipelineResults
+from ..data import DocumentDataset, analyze_documents
+from ..eval import SemanticEvaluator
+from ..strategies import get_strategy
+from ..text import DocumentTree, clean_thinking_tokens
+
+logger = get_logger("vnsum.pipeline")
+
+
+def model_name_safe(model: str) -> str:
+    """'llama3.2:3b' -> 'llama3_2_3b' (ref :170, :326)."""
+    return model.replace(":", "_").replace(".", "_")
+
+
+class PipelineRunner:
+    def __init__(
+        self,
+        config: PipelineConfig,
+        backend_factory=None,
+        embedding_model=None,
+    ) -> None:
+        self.config = config
+        self.backend_factory = backend_factory or self._default_backend_factory
+        self.embedding_model = embedding_model
+        self.results = PipelineResults(config=config.to_dict())
+        self.log_path = setup_run_logging(config.logs_dir)
+        logger.info("pipeline configured: approach=%s backend=%s models=%s",
+                    config.approach, config.backend, config.models)
+        # startup self-check, like the reference's cleaner sanity log (:193-197)
+        assert clean_thinking_tokens("<think>x</think>ok") == "ok"
+
+    # -- backend -----------------------------------------------------------
+
+    def _default_backend_factory(self, model: str) -> Backend:
+        cfg = self.config
+        if cfg.backend == "ollama":
+            return get_backend(
+                "ollama", model=model, url=cfg.ollama_url,
+                max_new_tokens=cfg.max_new_tokens,
+            )
+        if cfg.backend == "fake":
+            return get_backend("fake")
+        if cfg.backend == "tpu":
+            from ..models import MODEL_REGISTRY
+
+            if model not in MODEL_REGISTRY:
+                raise ValueError(
+                    f"unknown model {model!r} for tpu backend; "
+                    f"have {sorted(MODEL_REGISTRY)}"
+                )
+            mesh = None
+            if cfg.mesh_shape:
+                from ..parallel import make_mesh
+
+                mesh = make_mesh(dict(cfg.mesh_shape))
+            return get_backend(
+                "tpu",
+                model_config=MODEL_REGISTRY[model](),
+                tokenizer=cfg.tokenizer,
+                mesh=mesh,
+                batch_size=cfg.batch_size,
+                max_new_tokens=cfg.max_new_tokens,
+            )
+        raise ValueError(f"unknown backend {cfg.backend!r}")
+
+    def preflight(self, backend: Backend) -> None:
+        """Backend health check before any work (ref :199-233 checked the
+        Ollama server + model availability)."""
+        if backend.name == "ollama":
+            models = backend.health_check()
+            logger.info("ollama reachable; models: %s", models)
+        elif backend.name == "tpu":
+            import jax
+
+            devices = jax.devices()
+            logger.info("jax devices: %s", devices)
+            if not devices:
+                raise RuntimeError("no JAX devices available")
+
+    # -- phases ------------------------------------------------------------
+
+    def analyze(self) -> dict:
+        cfg = self.config
+        ds = DocumentDataset(cfg.docs_dir, cfg.summary_dir)
+        stats = analyze_documents(
+            ds, lambda t: len(t.split()), chunk_size=cfg.chunk_size,
+            max_samples=cfg.max_samples,
+        )
+        d = stats.to_dict()
+        d["per_document"] = d["per_document"][:1000]
+        self.results.document_stats = d
+        logger.info(
+            "analyzed %d docs: %d tokens total, ~%.0f/doc",
+            stats.total_documents, stats.total_tokens, stats.avg_tokens_per_doc,
+        )
+        return d
+
+    def _output_dir(self, model: str) -> Path:
+        # ref naming: <generated_summaries_dir>_<approach>_<model_safe> (:408)
+        return Path(
+            f"{self.config.generated_summaries_dir}_"
+            f"{self.config.approach}_{model_name_safe(model)}"
+        )
+
+    def run_summarization_for_model(self, model: str) -> ModelRunRecord:
+        cfg = self.config
+        record = ModelRunRecord(model=model, approach=cfg.approach)
+        t_start = time.time()
+
+        backend = self.backend_factory(model)
+        self.preflight(backend)
+        strategy = get_strategy(cfg.approach, backend, cfg)
+
+        ds = DocumentDataset(cfg.docs_dir, cfg.summary_dir)
+        out_dir = self._output_dir(model)
+        out_dir.mkdir(parents=True, exist_ok=True)
+
+        tree = None
+        if cfg.approach == "mapreduce_hierarchical":
+            tree_path = Path(cfg.tree_json_path)
+            if tree_path.is_file():
+                tree = DocumentTree.load(tree_path)
+            else:
+                logger.warning(
+                    "tree JSON %s missing; hierarchical will wrap plain text",
+                    tree_path,
+                )
+
+        names = ds.filenames(cfg.max_samples)
+        pending: list[str] = []
+        for name in names:
+            gen_path = out_dir / name
+            if gen_path.is_file():  # resume-by-file (ref :422-431)
+                logger.info("  %s: already exists, skipping", name)
+                continue
+            if self.config.summary_dir and not ds.has_reference(name):
+                logger.warning("  %s: no reference summary, skipping", name)
+                continue
+            pending.append(name)
+
+        logger.info(
+            "model %s: %d docs pending (%d total)", model, len(pending), len(names)
+        )
+
+        # submit documents in batches; each batch's map/collapse rounds share
+        # device batches inside the strategy
+        group_size = max(cfg.batch_size, 1)
+        for start in range(0, len(pending), group_size):
+            group = pending[start : start + group_size]
+            batch_t0 = time.time()
+            try:
+                if cfg.approach == "mapreduce_hierarchical" and tree is not None:
+                    roots, docs_fallback = [], []
+                    for name in group:
+                        node = tree.get(name)
+                        if node is None:
+                            docs_fallback.append(name)
+                        roots.append((name, node))
+                    results = []
+                    tree_items = [(n, r) for n, r in roots if r is not None]
+                    if tree_items:
+                        tree_results = strategy.summarize_tree_batch(
+                            [r for _, r in tree_items]
+                        )
+                        results.extend(zip([n for n, _ in tree_items], tree_results))
+                    if docs_fallback:
+                        texts = [ds.read_doc(n) for n in docs_fallback]
+                        results.extend(zip(docs_fallback, strategy.summarize_batch(texts)))
+                else:
+                    texts = [ds.read_doc(n) for n in group]
+                    results = list(zip(group, strategy.summarize_batch(texts)))
+            except Exception as e:
+                logger.error("batch failed (%s): %s", group, e)
+                logger.debug("%s", traceback.format_exc())
+                for name in group:
+                    record.failed += 1
+                    record.total_documents += 1
+                    record.processing_details.append(
+                        DocumentRecord(
+                            name, 0, time.time() - batch_t0, 0,
+                            status="failed", error=str(e),
+                        )
+                    )
+                continue
+
+            batch_time = time.time() - batch_t0
+            per_doc_time = batch_time / max(len(results), 1)
+            for name, res in results:
+                summary = clean_thinking_tokens(res.summary)  # ref :560-561
+                (out_dir / name).write_text(summary, encoding="utf-8")
+                record.total_documents += 1
+                record.successful += 1
+                record.total_chunks += res.num_chunks
+                record.processing_details.append(
+                    DocumentRecord(
+                        name, res.num_chunks, per_doc_time, len(summary)
+                    )
+                )
+            logger.info(
+                "  batch of %d docs in %.1fs (%.1fs/doc)",
+                len(results), batch_time, per_doc_time,
+            )
+
+        record.total_time = time.time() - t_start
+        self.results.add_summarization(record)
+        return record
+
+    def run_evaluation_for_model(self, model: str) -> dict:
+        cfg = self.config
+        embedder = self.embedding_model
+        if embedder is None:
+            from ..eval import EmbeddingModel
+
+            embedder = EmbeddingModel(batch_size=cfg.evaluation.bert_batch_size)
+        judge = None
+        if cfg.evaluation.include_llm_eval:
+            judge = self._build_llm_judge()
+        evaluator = SemanticEvaluator(
+            embedding_model=embedder,
+            include_llm_eval=judge is not None,
+            llm_judge=judge,
+        )
+        out_path = (
+            Path(cfg.results_dir) / f"{model_name_safe(model)}_results.json"
+        )
+        results = evaluator.evaluate_folders(
+            self._output_dir(model),
+            cfg.summary_dir,
+            max_samples=cfg.evaluation.max_samples,
+            output=out_path,
+        )
+        self.results.add_evaluation(model, results["summary_statistics"])
+        return results
+
+    def _build_llm_judge(self):
+        """G-Eval judge per EvalConfig: OpenRouter-compatible endpoint when
+        an API key is present (ref use_openrouter path), else skipped with a
+        warning — never a hard failure."""
+        import os
+
+        from ..eval import LLMJudge
+
+        cfg = self.config.evaluation
+        api_key = os.environ.get("OPENROUTER_API_KEY") or os.environ.get(
+            "OPENAI_API_KEY"
+        )
+        if not api_key:
+            logger.warning(
+                "include_llm_eval=True but no OPENROUTER_API_KEY/OPENAI_API_KEY "
+                "set; skipping G-Eval"
+            )
+            return None
+        base = (
+            "https://openrouter.ai/api/v1"
+            if cfg.use_openrouter
+            else "https://api.openai.com/v1"
+        )
+        return LLMJudge(api_base=base, api_key=api_key, model=cfg.llm_model)
+
+    # -- orchestration -----------------------------------------------------
+
+    def run(self) -> PipelineResults:
+        self.analyze()
+        for model in self.config.models:
+            try:
+                self.run_summarization_for_model(model)
+            except Exception as e:
+                logger.error("model %s summarization failed: %s", model, e)
+                logger.debug("%s", traceback.format_exc())
+                rec = ModelRunRecord(
+                    model=model, approach=self.config.approach,
+                    status="failed", error=str(e),
+                )
+                self.results.add_summarization(rec)
+                continue
+            try:
+                self.run_evaluation_for_model(model)
+            except Exception as e:
+                logger.error("model %s evaluation failed: %s", model, e)
+                self.results.add_evaluation(model, {"status": "failed", "error": str(e)})
+        path = self.results.save(self.config.results_dir)
+        logger.info("results saved to %s", path)
+        self.report()
+        return self.results
+
+    def report(self) -> str:
+        """Human-readable summary (ref generate_summary_report :841-925,
+        minus its '{:.4f}'.format('N/A') crash path)."""
+        lines = ["", "=" * 60, "PIPELINE SUMMARY", "=" * 60]
+        lines.append(f"approach: {self.config.approach}")
+        for model, rec in self.results.summarization.items():
+            lines.append(f"\nmodel {model}:")
+            lines.append(
+                f"  docs: {rec.get('successful', 0)} ok / {rec.get('failed', 0)} failed, "
+                f"chunks: {rec.get('total_chunks', 0)}, "
+                f"time: {rec.get('total_time', 0.0):.1f}s "
+                f"({rec.get('chunks_per_second', 0.0):.2f} chunks/s)"
+            )
+            ev = self.results.evaluation.get(model)
+            if ev and "rouge_scores" in ev:
+
+                def fmt(v):
+                    return f"{v:.4f}" if isinstance(v, (int, float)) else str(v)
+
+                rs = ev["rouge_scores"]
+                bs = ev.get("bert_scores", {})
+                ss = ev.get("semantic_similarity", {})
+                lines.append(
+                    f"  rouge1/2/L: {fmt(rs.get('rouge1_f1', 'N/A'))} / "
+                    f"{fmt(rs.get('rouge2_f1', 'N/A'))} / {fmt(rs.get('rougeL_f1', 'N/A'))}"
+                )
+                lines.append(
+                    f"  bert F1: {fmt(bs.get('bert_f1', 'N/A'))}  "
+                    f"semsim: {fmt(ss.get('mean', 'N/A'))}"
+                )
+        text = "\n".join(lines)
+        logger.info("%s", text)
+        return text

@@ -1,0 +1,63 @@
+"""Token statistics tool (capability match for utils/calculate_tokens.py in
+the reference: per-file token/char/word counts over a folder → JSON, which
+produced metadata/doc_metadata.json & summary_metadata.json).
+
+The tokenizer is any framework tokenizer spec ("byte" or "hf:<name>") rather
+than a hard HF dependency (ref default Qwen/Qwen3-4B, :7-19).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+from ..text.tokenizer import get_tokenizer, whitespace_token_count
+
+
+def count_stats(text: str, tok) -> dict:
+    return {
+        "tokens": tok.count(text),
+        "characters": len(text),
+        "words": whitespace_token_count(text),
+    }
+
+
+def process_folder(folder: str | Path, tokenizer: str = "byte") -> dict:
+    tok = get_tokenizer(tokenizer)
+    folder = Path(folder)
+    files = {}
+    totals = {"tokens": 0, "characters": 0, "words": 0}
+    for f in sorted(folder.glob("*.txt")):
+        stats = count_stats(f.read_text(encoding="utf-8"), tok)
+        files[f.name] = stats
+        for k in totals:
+            totals[k] += stats[k]
+    n = len(files)
+    return {
+        "summary": {
+            "total_files": n,
+            **{f"total_{k}": v for k, v in totals.items()},
+            **{f"avg_{k}": (v / n if n else 0.0) for k, v in totals.items()},
+            "tokenizer": tokenizer,
+        },
+        "files": files,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="vnsum-tokens")
+    p.add_argument("folder")
+    p.add_argument("--tokenizer", default="byte")
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+    result = process_folder(args.folder, args.tokenizer)
+    text = json.dumps(result, indent=2, ensure_ascii=False)
+    if args.output:
+        Path(args.output).write_text(text, encoding="utf-8")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

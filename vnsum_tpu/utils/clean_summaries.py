@@ -1,0 +1,54 @@
+"""Batch summary cleaner (capability match for utils/clean_summaries.py in
+the reference: strip <think>-style blocks from saved summaries, in place or
+into a new directory, with --preview).
+"""
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+from ..text.cleaning import clean_thinking_tokens
+
+
+def clean_summaries(
+    input_dir: str | Path,
+    output_dir: str | Path | None = None,
+    preview: bool = False,
+) -> dict:
+    src = Path(input_dir)
+    if not src.is_dir():
+        raise FileNotFoundError(f"input dir not found: {src}")
+    dst = Path(output_dir) if output_dir else src
+    changed, unchanged = [], []
+    for f in sorted(src.glob("*.txt")):
+        text = f.read_text(encoding="utf-8")
+        cleaned = clean_thinking_tokens(text)
+        if cleaned != text:
+            changed.append(f.name)
+            if not preview:
+                dst.mkdir(parents=True, exist_ok=True)
+                (dst / f.name).write_text(cleaned, encoding="utf-8")
+        else:
+            unchanged.append(f.name)
+            if not preview and dst != src:
+                dst.mkdir(parents=True, exist_ok=True)
+                (dst / f.name).write_text(text, encoding="utf-8")
+    return {"changed": changed, "unchanged": unchanged, "preview": preview}
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="vnsum-clean")
+    p.add_argument("input_dir")
+    p.add_argument("--output-dir", default=None)
+    p.add_argument("--preview", action="store_true")
+    args = p.parse_args(argv)
+    result = clean_summaries(args.input_dir, args.output_dir, args.preview)
+    print(
+        f"{'would clean' if args.preview else 'cleaned'} "
+        f"{len(result['changed'])} files; {len(result['unchanged'])} unchanged"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
