@@ -1,0 +1,81 @@
+"""Benchmark: batched map-step generation throughput on one TPU chip.
+
+Measures the engine doing what the reference does serially over HTTP: map-
+phase summarization calls (prompt -> generated continuation) on Llama-3.2-3B.
+The reference's best 3B-class throughput is ~0.25 chunks/sec TOTAL (VN-LongSum
+iterative, llama3.2:3b, BASELINE.md); here a "chunk" is one map call
+(bucket-1024 prompt + 128 generated tokens, batch 8).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "chunks/s", "vs_baseline": N/0.25}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REFERENCE_CHUNKS_PER_SEC = 0.25  # BASELINE.md: llama3.2:3b iterative, total
+
+
+def main() -> int:
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.models import llama32_3b
+
+    prompt_tokens = 1000  # buckets to S=1024
+    max_new = 128
+    batch = 8
+    rounds = 3
+
+    backend = TpuBackend(
+        model_config=llama32_3b(max_seq_len=4096),
+        tokenizer="byte",
+        batch_size=batch,
+        max_new_tokens=max_new,
+    )
+
+    base = (
+        "Bạn là một chuyên gia tóm tắt nội dung. "
+        "Vui lòng viết một bản tóm tắt chi tiết cho đoạn văn bản sau bằng tiếng Việt. "
+    )
+    filler = "Quốc hội đã thông qua nghị quyết về phát triển kinh tế xã hội. "
+    prompt = base + filler * ((prompt_tokens - len(base.encode())) // len(filler.encode()))
+    prompts = [prompt + f" (tài liệu {i})" for i in range(batch)]
+
+    t0 = time.time()
+    backend.generate(prompts)  # compile + warmup
+    warmup = time.time() - t0
+    print(f"warmup (incl. compile): {warmup:.1f}s", file=sys.stderr)
+
+    t1 = time.time()
+    done = 0
+    for r in range(rounds):
+        outs = backend.generate(
+            [p + f" vòng {r}" for p in prompts]
+        )
+        done += len(outs)
+    elapsed = time.time() - t1
+
+    chunks_per_sec = done / elapsed
+    stats = backend.stats
+    print(
+        f"{done} chunks in {elapsed:.1f}s; engine totals: "
+        f"{stats.prompt_tokens} prompt tok, {stats.generated_tokens} gen tok, "
+        f"{stats.tokens_per_second:.0f} tok/s overall",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "map_step_chunks_per_sec_per_chip_llama32_3b",
+                "value": round(chunks_per_sec, 4),
+                "unit": "chunks/s",
+                "vs_baseline": round(chunks_per_sec / REFERENCE_CHUNKS_PER_SEC, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -124,7 +124,9 @@ class TpuBackend:
             cache = init_kv_cache(cfg, B, C)
             positions = prefill_positions(pad_lens, S)
             mask = prefill_attention_mask(pad_lens, S, C)
-            logits, cache = forward(params, cfg, tokens, positions, cache, 0, mask)
+            logits, cache = forward(
+                params, cfg, tokens, positions, cache, 0, mask, last_only=True
+            )
             key = jax.random.key(seed)
             key, sub = jax.random.split(key)
             first = sample_logits(

@@ -47,6 +47,12 @@ class EmbeddingModel:
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
         self.max_len = max_len or self.cfg.max_len
         self.batch_size = batch_size
+        if self.tok.vocab_size > self.cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab ({self.tok.vocab_size}) exceeds encoder "
+                f"vocab ({self.cfg.vocab_size}); ids would clamp to garbage "
+                "embeddings — use an EncoderConfig sized for this tokenizer"
+            )
         self.params = params if params is not None else init_encoder_params(
             jax.random.key(seed), self.cfg
         )
@@ -123,16 +129,20 @@ def bert_scores(
     reference relies on at evaluate/evaluate_summaries_semantic.py:577-582)."""
     if len(candidates) != len(references):
         raise ValueError("candidates and references must align")
-    if not candidates:
-        return []
-    c_embs, c_mask = model.token_embeddings(candidates)
-    r_embs, r_mask = model.token_embeddings(references)
-    P, R = _greedy_match(
-        jnp.asarray(c_embs), jnp.asarray(c_mask), jnp.asarray(r_embs), jnp.asarray(r_mask)
-    )
-    P, R = np.asarray(P), np.asarray(R)
-    out = []
-    for p, r in zip(P.tolist(), R.tolist()):
-        f1 = 2 * p * r / (p + r) if (p + r) else 0.0
-        out.append(BertScore(p, r, f1))
+    out: list[BertScore] = []
+    # chunk the matching pass with the encode batch size so the [n, S, S]
+    # similarity tensor stays bounded regardless of corpus size
+    bs = model.batch_size
+    for start in range(0, len(candidates), bs):
+        cands = candidates[start : start + bs]
+        refs = references[start : start + bs]
+        c_embs, c_mask = model.token_embeddings(cands)
+        r_embs, r_mask = model.token_embeddings(refs)
+        P, R = _greedy_match(
+            jnp.asarray(c_embs), jnp.asarray(c_mask),
+            jnp.asarray(r_embs), jnp.asarray(r_mask),
+        )
+        for p, r in zip(np.asarray(P).tolist(), np.asarray(R).tolist()):
+            f1 = 2 * p * r / (p + r) if (p + r) else 0.0
+            out.append(BertScore(p, r, f1))
     return out

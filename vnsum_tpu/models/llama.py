@@ -216,8 +216,13 @@ def forward(
     mask: jax.Array,         # [B, S, C] bool over cache slots
     *,
     remat: bool = False,
+    last_only: bool = False,
 ) -> tuple[jax.Array, dict]:
-    """Run the decoder; returns (logits [B, S, vocab] f32, updated cache)."""
+    """Run the decoder; returns (logits [B, S, vocab] f32, updated cache).
+
+    ``last_only=True`` projects only the final position through the LM head
+    (prefill sampling needs just that; a full [B, S, vocab] f32 tensor at
+    S=2048 would be ~8 GB on the 128k vocab)."""
     x = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = _rope_cos_sin(cfg, positions)
 
@@ -235,12 +240,71 @@ def forward(
         layer_step, x, (params["layers"], kv_cache["k"], kv_cache["v"])
     )
 
+    if last_only:
+        x = x[:, -1:, :]
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum(
         "bsd,dv->bsv", x, head, preferred_element_type=jnp.float32
     )
     return logits, {"k": new_k, "v": new_v}
+
+
+def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, q_per_kv: int):
+    """Full causal attention without a cache (training path)."""
+    B, S = q.shape[0], q.shape[1]
+    i = jnp.arange(S)[None, :, None]
+    j = jnp.arange(S)[None, None, :]
+    mask = jnp.broadcast_to(j <= i, (B, S, S))
+    return _attention(q, k, v, mask, q_per_kv)
+
+
+def forward_train(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,        # [B, S] int32
+    *,
+    attention_fn=None,        # (q, k, v, q_per_kv) -> out; default dense causal
+    remat: bool = True,
+) -> jax.Array:
+    """Cache-free causal forward for training; returns logits [B, S, V] f32.
+
+    ``attention_fn`` is the sequence-parallelism seam: pass
+    parallel.ring.ring_attention (wrapped over a mesh) to run blockwise ring
+    attention over a sharded sequence axis instead of dense attention.
+    """
+    B, S = tokens.shape
+    attention_fn = attention_fn or dense_causal_attention
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    cos, sin = _rope_cos_sin(cfg, positions)
+
+    def block(x, lp):
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        attn = attention_fn(q, k, v, cfg.q_per_kv)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jnp.einsum("bsd,di->bsi", h, lp["w_gate"])
+        up = jnp.einsum("bsd,di->bsi", h, lp["w_up"])
+        return x + jnp.einsum(
+            "bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"]
+        )
+
+    if remat:
+        block = jax.checkpoint(block)
+
+    def layer_step(carry, lp):
+        return block(carry, lp), None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
 
 
 # -- mask / position helpers (host-independent, shape-static) ----------------

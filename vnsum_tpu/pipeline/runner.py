@@ -46,7 +46,8 @@ class PipelineRunner:
         logger.info("pipeline configured: approach=%s backend=%s models=%s",
                     config.approach, config.backend, config.models)
         # startup self-check, like the reference's cleaner sanity log (:193-197)
-        assert clean_thinking_tokens("<think>x</think>ok") == "ok"
+        if clean_thinking_tokens("<think>x</think>ok") != "ok":
+            raise RuntimeError("thinking-token cleaner self-check failed")
 
     # -- backend -----------------------------------------------------------
 
@@ -231,6 +232,7 @@ class PipelineRunner:
             from ..eval import EmbeddingModel
 
             embedder = EmbeddingModel(batch_size=cfg.evaluation.bert_batch_size)
+            self.embedding_model = embedder  # reuse across the model sweep
         judge = None
         if cfg.evaluation.include_llm_eval:
             judge = self._build_llm_judge()
@@ -245,7 +247,7 @@ class PipelineRunner:
         results = evaluator.evaluate_folders(
             self._output_dir(model),
             cfg.summary_dir,
-            max_samples=cfg.evaluation.max_samples,
+            max_samples=cfg.evaluation.max_samples or cfg.max_samples,
             output=out_path,
         )
         self.results.add_evaluation(model, results["summary_statistics"])

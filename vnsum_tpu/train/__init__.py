@@ -1,0 +1,3 @@
+from .trainer import TrainConfig, Trainer, lm_loss
+
+__all__ = ["TrainConfig", "Trainer", "lm_loss"]
