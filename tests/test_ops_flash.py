@@ -1,0 +1,86 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vnsum_tpu.models.llama import _attention, prefill_attention_mask
+from vnsum_tpu.ops.flash_attention import flash_prefill_attention, supports_flash
+
+
+def make_qkv(B, S, C, H, KV, hd, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(kq, (B, S, H, hd), jnp.float32)
+    k = jnp.zeros((B, C, KV, hd), jnp.float32)
+    v = jnp.zeros((B, C, KV, hd), jnp.float32)
+    # fill only the prefill region like the engine does
+    k = k.at[:, :S].set(jax.random.normal(kk, (B, S, KV, hd), jnp.float32))
+    v = v.at[:, :S].set(jax.random.normal(kv, (B, S, KV, hd), jnp.float32))
+    return q, k, v
+
+
+@pytest.mark.parametrize("pads", [[0, 0], [3, 17]])
+def test_flash_matches_dense(pads):
+    B, S, C, H, KV, hd = 2, 32, 64, 4, 2, 128
+    q, k, v = make_qkv(B, S, C, H, KV, hd)
+    pad = jnp.asarray(pads, jnp.int32)
+    mask = prefill_attention_mask(pad, S, C)
+    dense = _attention(q, k, v, mask, H // KV)
+    flash = flash_prefill_attention(q, k, v, pad, H // KV, interpret=True)
+    # compare only non-pad rows (pad rows are garbage on both paths)
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(dense)[b, pads[b] :],
+            np.asarray(flash)[b, pads[b] :],
+            rtol=2e-5,
+            atol=2e-5,
+        )
+
+
+def test_flash_multiple_k_blocks():
+    # force several K blocks (block picking lands on 64/32 divisors)
+    B, S, C, H, KV, hd = 1, 64, 192, 2, 1, 128
+    q, k, v = make_qkv(B, S, C, H, KV, hd, seed=3)
+    pad = jnp.asarray([5], jnp.int32)
+    mask = prefill_attention_mask(pad, S, C)
+    dense = _attention(q, k, v, mask, H // KV)
+    flash = flash_prefill_attention(
+        q, k, v, pad, H // KV, block_q=32, block_k=64, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense)[0, 5:], np.asarray(flash)[0, 5:], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_supports_flash():
+    assert supports_flash(1024, 1152, 128)
+    assert not supports_flash(1024, 1152, 64)   # head_dim not a lane multiple
+    assert not supports_flash(1001, 1152, 128)  # S has no block divisor
+    assert supports_flash(64, 1088, 128)
+
+
+def test_forward_remat_with_attention_fn():
+    """remat must treat attention_fn as static, not a traced operand."""
+    from vnsum_tpu.models import forward, init_kv_cache, init_params, tiny_llama
+    from vnsum_tpu.models.llama import _attention, prefill_positions
+
+    cfg = tiny_llama()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.ones((1, 8), jnp.int32)
+    pad = jnp.zeros((1,), jnp.int32)
+    cache = init_kv_cache(cfg, 1, 8)
+    mask = prefill_attention_mask(pad, 8, 8)
+    logits, _ = forward(
+        params, cfg, tokens, prefill_positions(pad, 8), cache, 0, mask,
+        remat=True,
+        attention_fn=lambda q, k, v, m, g: _attention(q, k, v, m, g),
+    )
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_unsupported_head_dim_raises():
+    B, S, C, H, KV, hd = 1, 8, 16, 2, 1, 64
+    q, k, v = make_qkv(B, S, C, H, KV, hd)
+    with pytest.raises(ValueError):
+        flash_prefill_attention(
+            q, k, v, jnp.zeros((1,), jnp.int32), 2, interpret=True
+        )

@@ -82,8 +82,14 @@ class TpuBackend:
         max_new_tokens: int = 1024,
         generation: GenerationConfig | None = None,
         seed: int = 0,
+        flash: str | bool = "auto",
     ) -> None:
         self.cfg = model_config or llama32_3b()
+        # Pallas flash prefill: "auto" enables it on real TPU only (the
+        # kernel needs Mosaic; CPU tests use interpret mode explicitly)
+        if flash == "auto":
+            flash = jax.default_backend() == "tpu" and mesh is None
+        self.flash = bool(flash)
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
         self.mesh = mesh
         self.batch_size = batch_size
@@ -120,12 +126,28 @@ class TpuBackend:
         )
         pad_id = self.tok.pad_id
 
+        use_flash = self.flash
+        if use_flash:
+            from ..ops.flash_attention import supports_flash
+
+            use_flash = supports_flash(S, C, cfg.head_dim)
+
         def generate(params, tokens, pad_lens, seed):
             cache = init_kv_cache(cfg, B, C)
             positions = prefill_positions(pad_lens, S)
             mask = prefill_attention_mask(pad_lens, S, C)
+            attention_fn = None
+            if use_flash:
+                from ..ops.flash_attention import flash_prefill_attention
+
+                def attention_fn(q, k_cache, v_cache, _mask, q_per_kv):
+                    return flash_prefill_attention(
+                        q, k_cache, v_cache, pad_lens, q_per_kv
+                    )
+
             logits, cache = forward(
-                params, cfg, tokens, positions, cache, 0, mask, last_only=True
+                params, cfg, tokens, positions, cache, 0, mask,
+                last_only=True, attention_fn=attention_fn,
             )
             key = jax.random.key(seed)
             key, sub = jax.random.split(key)

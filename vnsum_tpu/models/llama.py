@@ -183,8 +183,18 @@ def _attention(
     return out.reshape(B, S, H, hd)
 
 
-def _block(x, lp, cos, sin, mask, k_cache, v_cache, write_index, cfg: LlamaConfig):
-    """One decoder layer. k_cache/v_cache are this layer's [B, C, KV, hd]."""
+def _block(
+    x, lp, layer_idx, cos, sin, mask, k_all, v_all, write_index,
+    cfg: LlamaConfig, attention_fn=None,
+):
+    """One decoder layer.
+
+    ``k_all``/``v_all`` are the FULL stacked caches [L, B, C, KV, hd]; only
+    the [S]-token slice of layer ``layer_idx`` is written (a tiny in-place
+    dynamic_update_slice on the scan carry). Carrying the whole cache and
+    writing the small slice keeps decode HBM traffic at weights+cache-read —
+    emitting per-layer caches as scan outputs would re-materialize the whole
+    ~GB cache every decode step."""
     h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
@@ -192,10 +202,19 @@ def _block(x, lp, cos, sin, mask, k_cache, v_cache, write_index, cfg: LlamaConfi
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
 
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, write_index, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, write_index, 0, 0))
+    k_all = jax.lax.dynamic_update_slice(
+        k_all, k[None], (layer_idx, 0, write_index, 0, 0)
+    )
+    v_all = jax.lax.dynamic_update_slice(
+        v_all, v[None], (layer_idx, 0, write_index, 0, 0)
+    )
+    k_cache = jax.lax.dynamic_index_in_dim(k_all, layer_idx, 0, keepdims=False)
+    v_cache = jax.lax.dynamic_index_in_dim(v_all, layer_idx, 0, keepdims=False)
 
-    attn = _attention(q, k_cache, v_cache, mask, cfg.q_per_kv)
+    if attention_fn is None:
+        attn = _attention(q, k_cache, v_cache, mask, cfg.q_per_kv)
+    else:
+        attn = attention_fn(q, k_cache, v_cache, mask, cfg.q_per_kv)
     attn_out = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
     x = x + attn_out
 
@@ -203,7 +222,7 @@ def _block(x, lp, cos, sin, mask, k_cache, v_cache, write_index, cfg: LlamaConfi
     gate = jnp.einsum("bsd,di->bsi", h, lp["w_gate"])
     up = jnp.einsum("bsd,di->bsi", h, lp["w_up"])
     mlp_out = jnp.einsum("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"])
-    return x + mlp_out, k_cache, v_cache
+    return x + mlp_out, k_all, v_all
 
 
 def forward(
@@ -217,27 +236,36 @@ def forward(
     *,
     remat: bool = False,
     last_only: bool = False,
+    attention_fn=None,
 ) -> tuple[jax.Array, dict]:
     """Run the decoder; returns (logits [B, S, vocab] f32, updated cache).
 
     ``last_only=True`` projects only the final position through the LM head
     (prefill sampling needs just that; a full [B, S, vocab] f32 tensor at
-    S=2048 would be ~8 GB on the 128k vocab)."""
+    S=2048 would be ~8 GB on the 128k vocab).
+
+    ``attention_fn(q, k_cache, v_cache, mask, q_per_kv)`` overrides the
+    dense cache attention (e.g. the Pallas flash kernel for prefill)."""
     x = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = _rope_cos_sin(cfg, positions)
 
     block = _block
     if remat:
-        block = jax.checkpoint(_block, static_argnums=(8,))
+        block = jax.checkpoint(_block, static_argnums=(9, 10))
 
     def layer_step(carry, xs):
-        h = carry
-        lp, k_c, v_c = xs
-        h, k_c, v_c = block(h, lp, cos, sin, mask, k_c, v_c, write_index, cfg)
-        return h, (k_c, v_c)
+        h, k_all, v_all = carry
+        lp, li = xs
+        h, k_all, v_all = block(
+            h, lp, li, cos, sin, mask, k_all, v_all, write_index, cfg,
+            attention_fn,
+        )
+        return (h, k_all, v_all), None
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    (x, new_k, new_v), _ = jax.lax.scan(
+        layer_step,
+        (x, kv_cache["k"], kv_cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
     )
 
     if last_only:

@@ -1,0 +1,3 @@
+from .flash_attention import flash_prefill_attention, supports_flash
+
+__all__ = ["flash_prefill_attention", "supports_flash"]

@@ -1,0 +1,178 @@
+"""Pallas TPU flash attention for the prefill path.
+
+The XLA attention in models.llama materializes the full [B, KV, G, S, C]
+f32 score tensor — at S=8k, C=9k that alone is >30 GB, capping chunk sizes
+far below the reference's 12k-token chunks (SURVEY.md §5). This kernel
+computes attention blockwise with online-softmax scratch accumulators, so
+VMEM holds only (BQ × BK) score tiles and HBM never sees a score tensor:
+
+- grid (B, H, S/BQ, C/BK), K-block innermost; scratch (acc, m, l) carries the
+  running softmax across K blocks; output block written once on the last;
+- causal + left-pad masking fused into the kernel (same semantics as
+  models.llama.prefill_attention_mask: pad_b <= j <= i), with pad lengths
+  delivered via scalar prefetch;
+- GQA folded into the index map: query head h reads KV head h // q_per_kv —
+  no repeated K/V in memory;
+- blocks strictly above the causal diagonal skip their FLOPs entirely.
+
+Inference-only (no VJP); training uses dense or ring attention.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # python float: jnp constants would be captured by the kernel
+_LANES = 128
+
+
+def _kernel(
+    pad_ref,   # [B] int32 (scalar prefetch, SMEM)
+    q_ref,     # [1, 1, BQ, hd]
+    k_ref,     # [1, 1, BK, hd]
+    v_ref,     # [1, 1, BK, hd]
+    o_ref,     # [1, 1, BQ, hd]
+    acc_ref,   # [BQ, hd] f32
+    m_ref,     # [BQ, LANES] f32
+    l_ref,     # [BQ, LANES] f32
+    *,
+    block_q: int,
+    block_k: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    q_start = i * block_q
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # blocks strictly above the causal diagonal contribute nothing
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _compute():
+        qb = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [BQ, BK]
+
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        pad = pad_ref[b]
+        mask = (k_pos <= q_pos) & (k_pos >= pad)
+        s = jnp.where(mask, s, _NEG)
+
+        m_prev = m_ref[:, :1]                       # [BQ, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)   # [BQ, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)                 # dead rows stay dead
+
+        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _pick_block(n: int, preferred: int) -> int | None:
+    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
+        if b <= preferred and n % b == 0:
+            return b
+    return None
+
+
+def supports_flash(seq_len: int, cache_len: int, head_dim: int) -> bool:
+    """Shapes the kernel can tile: hd a lane multiple, dims block-divisible."""
+    return (
+        head_dim % _LANES == 0
+        and _pick_block(seq_len, 512) is not None
+        and _pick_block(cache_len, 512) is not None
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("q_per_kv", "block_q", "block_k", "interpret"),
+)
+def flash_prefill_attention(
+    q: jax.Array,         # [B, S, H, hd]
+    k: jax.Array,         # [B, C, KV, hd]
+    v: jax.Array,         # [B, C, KV, hd]
+    pad_lens: jax.Array,  # [B] int32 — left-pad per sequence
+    q_per_kv: int,
+    *,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, S, H, hd]; semantics match _attention with the prefill
+    mask (pad_b <= j <= i over cache slots)."""
+    B, S, H, hd = q.shape
+    C = k.shape[1]
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(C, block_k)
+    if bq is None or bk is None or hd % _LANES:
+        raise ValueError(f"unsupported flash shapes S={S} C={C} hd={hd}")
+
+    qt = q.transpose(0, 2, 1, 3)   # [B, H, S, hd]
+    kt = k.transpose(0, 2, 1, 3)   # [B, KV, C, hd]
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (B, H, S // bq, C // bk)
+    kernel = functools.partial(
+        _kernel, block_q=bq, block_k=bk, scale=1.0 / (hd ** 0.5)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, bq, hd), lambda b, h, i, j, p: (b, h, i, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, bk, hd),
+                    lambda b, h, i, j, p, g=q_per_kv: (b, h // g, j, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, bk, hd),
+                    lambda b, h, i, j, p, g=q_per_kv: (b, h // g, j, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, bq, hd), lambda b, h, i, j, p: (b, h, i, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((bq, hd), jnp.float32),
+                pltpu.VMEM((bq, _LANES), jnp.float32),
+                pltpu.VMEM((bq, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        interpret=interpret,
+    )(pad_lens.astype(jnp.int32), qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

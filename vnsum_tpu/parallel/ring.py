@@ -70,8 +70,18 @@ def _ring_local(qb, kb, vb, q_per_kv: int, axis_name: str, causal: bool):
         o = o * correction[..., None] + jnp.einsum(
             "bkgsc,bckh->bkgsh", p, v_cur.astype(jnp.float32)
         )
-        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        def rotate(kv):
+            k_c, v_c = kv
+            return (
+                jax.lax.ppermute(k_c, axis_name, perm),
+                jax.lax.ppermute(v_c, axis_name, perm),
+            )
+
+        # the last block's rotation would be discarded — skip the transfer
+        # (predicate is uniform across devices, so cond is collective-safe)
+        k_next, v_next = jax.lax.cond(
+            i < n - 1, rotate, lambda kv: kv, (k_cur, v_cur)
+        )
         return o, m_new, l, k_next, v_next
 
     o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, kb, vb))

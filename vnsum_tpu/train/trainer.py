@@ -130,31 +130,29 @@ class Trainer:
         )
 
     def _opt_state_specs(self):
-        """PartitionSpecs for the optax state: moment trees mirror the param
-        specs; scalar counts replicate."""
+        """PartitionSpecs for the optax state: any state subtree that has the
+        params' exact tree structure (AdamW mu/nu) inherits the param specs;
+        every other leaf (counters, empty states) replicates."""
         specs = param_specs(self.cfg.tie_embeddings)
         abstract = jax.eval_shape(
             lambda: init_params(jax.random.key(0), self.cfg)
         )
+        params_def = jax.tree.structure(abstract)
         state_shape = jax.eval_shape(self.optimizer.init, abstract)
 
-        def map_state(leaf_shape_tree):
-            # any leaf whose shape matches a param leaf gets that param's
-            # spec; everything else (scalars/counters) replicates
-            flat_params, _ = jax.tree.flatten(abstract)
-            flat_specs, _ = jax.tree.flatten(
-                specs, is_leaf=lambda x: isinstance(x, P)
-            )
-            shape_to_spec = {}
-            for pl, sp in zip(flat_params, flat_specs):
-                shape_to_spec.setdefault(pl.shape, sp)
+        def is_param_subtree(x):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return False
+            try:
+                return jax.tree.structure(x) == params_def
+            except Exception:
+                return False
 
-            def one(leaf):
-                return shape_to_spec.get(getattr(leaf, "shape", None), P())
-
-            return jax.tree.map(one, leaf_shape_tree)
-
-        return map_state(state_shape)
+        return jax.tree.map(
+            lambda x: specs if is_param_subtree(x) else P(),
+            state_shape,
+            is_leaf=is_param_subtree,
+        )
 
     def step(self, tokens, loss_mask=None):
         """One optimizer step; tokens [B, S] int32. Returns float loss."""
