@@ -1,0 +1,498 @@
+// vnsum_native — C++ host-side text core exposed over a C ABI (ctypes).
+//
+// The reference has no native code at all (SURVEY.md §2); this library takes
+// the host-side hot loops off the single pipeline CPU so it can keep feeding
+// the TPU: ROUGE-1/2/L scoring (tokenize + NLTK-mode Porter stemmer + O(n*m)
+// LCS — the dominant host cost of the evaluation pass,
+// evaluate/evaluate_summaries_semantic.py:561-575) and the recursive
+// byte-budget text splitter used by the engine's default tokenizer.
+//
+// Semantics mirror vnsum_tpu/eval/rouge.py and vnsum_tpu/text/splitter.py
+// exactly; tests fuzz both against the Python implementations.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- stemmer
+
+// consonant test matching nltk: y is a consonant at 0, else a consonant iff
+// the previous char is a vowel (i.e. not consonant(prev))
+bool is_cons(const std::string& w, int i) {
+    char c = w[i];
+    if (c == 'a' || c == 'e' || c == 'i' || c == 'o' || c == 'u') return false;
+    if (c == 'y') return i == 0 ? true : !is_cons(w, i - 1);
+    return true;
+}
+
+int measure(const std::string& stem) {
+    int m = 0;
+    bool prev_v = false;
+    for (int i = 0; i < (int)stem.size(); ++i) {
+        bool v = !is_cons(stem, i);
+        if (!v && prev_v) ++m;  // count v->c transitions
+        prev_v = v;
+    }
+    return m;
+}
+
+bool has_vowel(const std::string& s) {
+    for (int i = 0; i < (int)s.size(); ++i)
+        if (!is_cons(s, i)) return true;
+    return false;
+}
+
+bool ends_double_cons(const std::string& w) {
+    int n = w.size();
+    return n >= 2 && w[n - 1] == w[n - 2] && is_cons(w, n - 1);
+}
+
+bool ends_cvc(const std::string& w) {
+    int n = w.size();
+    if (n >= 3 && is_cons(w, n - 3) && !is_cons(w, n - 2) && is_cons(w, n - 1)) {
+        char c = w[n - 1];
+        if (c != 'w' && c != 'x' && c != 'y') return true;
+    }
+    // NLTK extension: 2-letter vc counts
+    return n == 2 && !is_cons(w, 0) && is_cons(w, 1);
+}
+
+bool ends_with(const std::string& w, const char* suf) {
+    size_t l = std::strlen(suf);
+    return w.size() >= l && w.compare(w.size() - l, l, suf) == 0;
+}
+
+struct Rule {
+    const char* suffix;
+    const char* repl;
+    int cond;  // 0: none, 1: m>0, 2: m>1, 3: m>1 && stem ends s/t
+};
+
+// first matching suffix wins; failed condition stops the step
+std::string apply_rules(const std::string& w, const Rule* rules, int n) {
+    for (int r = 0; r < n; ++r) {
+        if (!ends_with(w, rules[r].suffix)) continue;
+        std::string stem = w.substr(0, w.size() - std::strlen(rules[r].suffix));
+        bool ok = true;
+        switch (rules[r].cond) {
+            case 1: ok = measure(stem) > 0; break;
+            case 2: ok = measure(stem) > 1; break;
+            case 3:
+                ok = measure(stem) > 1 && !stem.empty() &&
+                     (stem.back() == 's' || stem.back() == 't');
+                break;
+        }
+        return ok ? stem + rules[r].repl : w;
+    }
+    return w;
+}
+
+std::string step1a(const std::string& w) {
+    if (ends_with(w, "ies") && w.size() == 4) return w.substr(0, 1) + "ie";
+    static const Rule rules[] = {
+        {"sses", "ss", 0}, {"ies", "i", 0}, {"ss", "ss", 0}, {"s", "", 0}};
+    return apply_rules(w, rules, 4);
+}
+
+std::string step1b(const std::string& w) {
+    if (ends_with(w, "ied"))
+        return w.substr(0, w.size() - 3) + (w.size() == 4 ? "ie" : "i");
+    if (ends_with(w, "eed")) {
+        std::string stem = w.substr(0, w.size() - 3);
+        return measure(stem) > 0 ? stem + "ee" : w;
+    }
+    std::string inter;
+    bool matched = false;
+    if (ends_with(w, "ed")) {
+        std::string stem = w.substr(0, w.size() - 2);
+        if (has_vowel(stem)) { inter = stem; matched = true; }
+    } else if (ends_with(w, "ing")) {
+        std::string stem = w.substr(0, w.size() - 3);
+        if (has_vowel(stem)) { inter = stem; matched = true; }
+    }
+    if (!matched) return w;
+    if (ends_with(inter, "at") || ends_with(inter, "bl") || ends_with(inter, "iz"))
+        return inter + "e";
+    if (ends_double_cons(inter)) {
+        char last = inter.back();
+        if (last != 'l' && last != 's' && last != 'z')
+            return inter.substr(0, inter.size() - 1);
+        return inter;  // condition failed on matched *d rule -> stop
+    }
+    if (measure(inter) == 1 && ends_cvc(inter)) return inter + "e";
+    return inter;
+}
+
+std::string step1c(const std::string& w) {
+    if (!ends_with(w, "y")) return w;
+    std::string stem = w.substr(0, w.size() - 1);
+    if (stem.size() > 1 && is_cons(stem, stem.size() - 1)) return stem + "i";
+    return w;
+}
+
+std::string step2(const std::string& w) {
+    if (ends_with(w, "alli")) {
+        std::string stem = w.substr(0, w.size() - 4);
+        if (measure(stem) > 0) return step2(stem + "al");
+    }
+    static const Rule rules[] = {
+        {"ational", "ate", 1}, {"tional", "tion", 1}, {"enci", "ence", 1},
+        {"anci", "ance", 1},   {"izer", "ize", 1},    {"bli", "ble", 1},
+        {"alli", "al", 1},     {"entli", "ent", 1},   {"eli", "e", 1},
+        {"ousli", "ous", 1},   {"ization", "ize", 1}, {"ation", "ate", 1},
+        {"ator", "ate", 1},    {"alism", "al", 1},    {"iveness", "ive", 1},
+        {"fulness", "ful", 1}, {"ousness", "ous", 1}, {"aliti", "al", 1},
+        {"iviti", "ive", 1},   {"biliti", "ble", 1},  {"fulli", "ful", 1}};
+    for (const Rule& r : rules) {
+        if (!ends_with(w, r.suffix)) continue;
+        std::string stem = w.substr(0, w.size() - std::strlen(r.suffix));
+        return measure(stem) > 0 ? stem + r.repl : w;
+    }
+    if (ends_with(w, "logi")) {
+        // condition is on word minus "ogi" (the 'l' stays with the stem)
+        std::string stem_l = w.substr(0, w.size() - 3);
+        if (measure(stem_l) > 0) return w.substr(0, w.size() - 4) + "log";
+        return w;
+    }
+    return w;
+}
+
+std::string step3(const std::string& w) {
+    static const Rule rules[] = {
+        {"icate", "ic", 1}, {"ative", "", 1}, {"alize", "al", 1},
+        {"iciti", "ic", 1}, {"ical", "ic", 1}, {"ful", "", 1},
+        {"ness", "", 1}};
+    return apply_rules(w, rules, 7);
+}
+
+std::string step4(const std::string& w) {
+    static const Rule rules[] = {
+        {"al", "", 2},   {"ance", "", 2}, {"ence", "", 2}, {"er", "", 2},
+        {"ic", "", 2},   {"able", "", 2}, {"ible", "", 2}, {"ant", "", 2},
+        {"ement", "", 2}, {"ment", "", 2}, {"ent", "", 2}, {"ion", "", 3},
+        {"ou", "", 2},   {"ism", "", 2},  {"ate", "", 2},  {"iti", "", 2},
+        {"ous", "", 2},  {"ive", "", 2},  {"ize", "", 2}};
+    return apply_rules(w, rules, 19);
+}
+
+std::string step5a(const std::string& w) {
+    if (!ends_with(w, "e")) return w;
+    std::string stem = w.substr(0, w.size() - 1);
+    int m = measure(stem);
+    if (m > 1) return stem;
+    if (m == 1 && !ends_cvc(stem)) return stem;
+    return w;
+}
+
+std::string step5b(const std::string& w) {
+    if (ends_with(w, "ll") && measure(w.substr(0, w.size() - 1)) > 1)
+        return w.substr(0, w.size() - 1);
+    return w;
+}
+
+std::string porter_stem(const std::string& word) {
+    static const std::unordered_map<std::string, std::string> irregular = {
+        {"skies", "sky"},     {"sky", "sky"},       {"dying", "die"},
+        {"lying", "lie"},     {"tying", "tie"},     {"news", "news"},
+        {"innings", "inning"}, {"inning", "inning"}, {"outings", "outing"},
+        {"outing", "outing"}, {"cannings", "canning"}, {"canning", "canning"},
+        {"howe", "howe"},     {"proceed", "proceed"}, {"exceed", "exceed"},
+        {"succeed", "succeed"}};
+    auto it = irregular.find(word);
+    if (it != irregular.end()) return it->second;
+    if (word.size() <= 2) return word;
+    std::string w = word;
+    w = step1a(w);
+    w = step1b(w);
+    w = step1c(w);
+    w = step2(w);
+    w = step3(w);
+    w = step4(w);
+    w = step5a(w);
+    w = step5b(w);
+    return w;
+}
+
+// ------------------------------------------------------------- tokenizer
+
+// rouge_score tokenization: lowercase, non-[a-z0-9] bytes are separators,
+// stem tokens longer than 3 chars
+std::vector<std::string> rouge_tokenize(const char* text, bool use_stemmer) {
+    std::vector<std::string> out;
+    std::string cur;
+    for (const unsigned char* p = (const unsigned char*)text; *p; ++p) {
+        unsigned char c = *p;
+        if (c >= 'A' && c <= 'Z') c = c - 'A' + 'a';
+        if ((c >= 'a' && c <= 'z') || (c >= '0' && c <= '9')) {
+            cur.push_back((char)c);
+        } else if (!cur.empty()) {
+            out.push_back(std::move(cur));
+            cur.clear();
+        }
+    }
+    if (!cur.empty()) out.push_back(std::move(cur));
+    if (use_stemmer) {
+        for (auto& t : out)
+            if (t.size() > 3) t = porter_stem(t);
+    }
+    return out;
+}
+
+// ----------------------------------------------------------------- rouge
+
+using TokenIds = std::vector<int>;
+
+TokenIds intern(const std::vector<std::string>& toks,
+                std::unordered_map<std::string, int>& vocab) {
+    TokenIds ids;
+    ids.reserve(toks.size());
+    for (const auto& t : toks) {
+        auto it = vocab.find(t);
+        if (it == vocab.end()) it = vocab.emplace(t, (int)vocab.size()).first;
+        ids.push_back(it->second);
+    }
+    return ids;
+}
+
+void score_ngrams(const TokenIds& target, const TokenIds& pred, int n,
+                  double* p, double* r, double* f) {
+    std::unordered_map<uint64_t, int> t_counts, p_counts;
+    auto key = [](const TokenIds& v, size_t i, int n) {
+        uint64_t h = 1469598103934665603ull;
+        for (int j = 0; j < n; ++j) {
+            h ^= (uint64_t)(v[i + j] + 1);
+            h *= 1099511628211ull;
+        }
+        return h;
+    };
+    for (size_t i = 0; i + n <= target.size(); ++i) ++t_counts[key(target, i, n)];
+    for (size_t i = 0; i + n <= pred.size(); ++i) ++p_counts[key(pred, i, n)];
+    long overlap = 0, t_total = 0, p_total = 0;
+    for (auto& kv : t_counts) {
+        t_total += kv.second;
+        auto it = p_counts.find(kv.first);
+        if (it != p_counts.end()) overlap += std::min(kv.second, it->second);
+    }
+    for (auto& kv : p_counts) p_total += kv.second;
+    *p = p_total ? (double)overlap / p_total : 0.0;
+    *r = t_total ? (double)overlap / t_total : 0.0;
+    *f = (*p + *r) ? 2 * (*p) * (*r) / (*p + *r) : 0.0;
+}
+
+int lcs_len(const TokenIds& a, const TokenIds& b) {
+    if (a.empty() || b.empty()) return 0;
+    std::vector<int> prev(b.size() + 1, 0), cur(b.size() + 1, 0);
+    for (size_t i = 1; i <= a.size(); ++i) {
+        int ai = a[i - 1];
+        for (size_t j = 1; j <= b.size(); ++j) {
+            cur[j] = (ai == b[j - 1]) ? prev[j - 1] + 1
+                                      : std::max(prev[j], cur[j - 1]);
+        }
+        std::swap(prev, cur);
+    }
+    return prev[b.size()];
+}
+
+}  // namespace
+
+extern "C" {
+
+// out9 = [p1, r1, f1, p2, r2, f2, pL, rL, fL]
+void vn_rouge_score(const char* target, const char* prediction,
+                    int use_stemmer, double* out9) {
+    std::unordered_map<std::string, int> vocab;
+    TokenIds t = intern(rouge_tokenize(target, use_stemmer), vocab);
+    TokenIds p = intern(rouge_tokenize(prediction, use_stemmer), vocab);
+    score_ngrams(t, p, 1, &out9[0], &out9[1], &out9[2]);
+    score_ngrams(t, p, 2, &out9[3], &out9[4], &out9[5]);
+    if (t.empty() || p.empty()) {
+        out9[6] = out9[7] = out9[8] = 0.0;
+    } else {
+        int l = lcs_len(t, p);
+        double pr = (double)l / p.size();
+        double rc = (double)l / t.size();
+        out9[6] = pr;
+        out9[7] = rc;
+        out9[8] = (pr + rc) ? 2 * pr * rc / (pr + rc) : 0.0;
+    }
+}
+
+void vn_rouge_corpus(const char** targets, const char** preds, int n,
+                     int use_stemmer, double* out /* n*9 */) {
+    for (int i = 0; i < n; ++i)
+        vn_rouge_score(targets[i], preds[i], use_stemmer, out + 9 * i);
+}
+
+// stem one word (ASCII, already lowercased); returns length written
+int vn_porter_stem(const char* word, char* out, int out_cap) {
+    std::string s = porter_stem(word);
+    int n = std::min((int)s.size(), out_cap - 1);
+    std::memcpy(out, s.data(), n);
+    out[n] = '\0';
+    return n;
+}
+
+int vn_count_words(const char* text) {
+    int count = 0;
+    bool in_word = false;
+    for (const unsigned char* p = (const unsigned char*)text; *p; ++p) {
+        // match Python str.split(): any unicode whitespace; for UTF-8 input
+        // ASCII whitespace covers the practical cases in this corpus
+        bool ws = *p == ' ' || *p == '\t' || *p == '\n' || *p == '\r' ||
+                  *p == '\f' || *p == '\v';
+        if (!ws && !in_word) { ++count; in_word = true; }
+        if (ws) in_word = false;
+    }
+    return count;
+}
+
+// Recursive byte-budget splitter matching RecursiveTokenSplitter with the
+// byte-count length function and the Vietnamese separator ladder.
+// Chunks are written concatenated into `out` with their byte lengths in
+// `lens_out`. Returns the chunk count, or -1 if either buffer is too small.
+int vn_split_bytes(const char* text, int chunk_size, int chunk_overlap,
+                   char* out, long out_cap, int* lens_out, int max_chunks);
+
+namespace splitdetail {
+
+const char* const SEPS[] = {"\n\n", "\n", ".", "!", "?", ";", " ", ""};
+const int NSEPS = 8;
+
+void split_on(const std::string& text, const std::string& sep,
+              std::vector<std::string>& out) {
+    out.clear();
+    if (sep.empty()) {
+        // one piece per UTF-8 codepoint (continuation bytes 10xxxxxx stay
+        // attached), matching Python's per-character split
+        size_t i = 0;
+        while (i < text.size()) {
+            size_t j = i + 1;
+            while (j < text.size() && (text[j] & 0xC0) == 0x80) ++j;
+            out.push_back(text.substr(i, j - i));
+            i = j;
+        }
+        return;
+    }
+    // separator glued to the FOLLOWING piece
+    size_t pos = 0, prev = 0;
+    bool first = true;
+    std::string pending;
+    while ((pos = text.find(sep, prev)) != std::string::npos) {
+        std::string piece = text.substr(prev, pos - prev);
+        if (first) {
+            if (!piece.empty()) out.push_back(piece);
+            first = false;
+        } else {
+            std::string merged = pending + piece;
+            if (!merged.empty()) out.push_back(merged);
+        }
+        pending = sep;
+        prev = pos + sep.size();
+    }
+    std::string tail = text.substr(prev);
+    if (first) {
+        if (!tail.empty()) out.push_back(tail);
+    } else {
+        std::string merged = pending + tail;
+        if (!merged.empty()) out.push_back(merged);
+    }
+}
+
+std::string strip(const std::string& s) {
+    size_t a = s.find_first_not_of(" \t\n\r\f\v");
+    if (a == std::string::npos) return "";
+    size_t b = s.find_last_not_of(" \t\n\r\f\v");
+    return s.substr(a, b - a + 1);
+}
+
+void merge(const std::vector<std::string>& pieces, int chunk_size,
+           int chunk_overlap, std::vector<std::string>& chunks) {
+    std::vector<std::string> window;
+    std::vector<long> lens;
+    long total = 0;
+    for (const auto& piece : pieces) {
+        long plen = piece.size();
+        if (total + plen > chunk_size && !window.empty()) {
+            std::string joined;
+            for (const auto& w : window) joined += w;
+            joined = strip(joined);
+            if (!joined.empty()) chunks.push_back(joined);
+            while (!window.empty() &&
+                   (total > chunk_overlap ||
+                    (total + plen > chunk_size && total > 0))) {
+                total -= lens.front();
+                window.erase(window.begin());
+                lens.erase(lens.begin());
+            }
+        }
+        window.push_back(piece);
+        lens.push_back(plen);
+        total += plen;
+    }
+    std::string joined;
+    for (const auto& w : window) joined += w;
+    joined = strip(joined);
+    if (!joined.empty()) chunks.push_back(joined);
+}
+
+void split_rec(const std::string& text, int chunk_size, int chunk_overlap,
+               int sep_start, std::vector<std::string>& chunks) {
+    int sep_idx = NSEPS - 1;
+    int next_start = NSEPS;  // none
+    for (int i = sep_start; i < NSEPS; ++i) {
+        if (SEPS[i][0] == '\0') { sep_idx = i; break; }
+        if (text.find(SEPS[i]) != std::string::npos) {
+            sep_idx = i;
+            next_start = i + 1;
+            break;
+        }
+    }
+    std::vector<std::string> pieces;
+    split_on(text, SEPS[sep_idx], pieces);
+
+    std::vector<std::string> small;
+    for (auto& piece : pieces) {
+        if ((int)piece.size() < chunk_size) {
+            small.push_back(piece);
+        } else {
+            if (!small.empty()) {
+                merge(small, chunk_size, chunk_overlap, chunks);
+                small.clear();
+            }
+            if (next_start >= NSEPS) {
+                chunks.push_back(piece);
+            } else {
+                split_rec(piece, chunk_size, chunk_overlap, next_start, chunks);
+            }
+        }
+    }
+    if (!small.empty()) merge(small, chunk_size, chunk_overlap, chunks);
+}
+
+}  // namespace splitdetail
+
+int vn_split_bytes(const char* text, int chunk_size, int chunk_overlap,
+                   char* out, long out_cap, int* lens_out, int max_chunks) {
+    std::vector<std::string> chunks;
+    std::string s(text);
+    if (s.empty()) return 0;
+    splitdetail::split_rec(s, chunk_size, chunk_overlap, 0, chunks);
+    if ((int)chunks.size() > max_chunks) return -1;
+    long need = 0;
+    for (auto& c : chunks) need += (long)c.size();
+    if (need > out_cap) return -1;
+    char* w = out;
+    for (size_t i = 0; i < chunks.size(); ++i) {
+        std::memcpy(w, chunks[i].data(), chunks[i].size());
+        w += chunks[i].size();
+        lens_out[i] = (int)chunks[i].size();
+    }
+    return (int)chunks.size();
+}
+
+}  // extern "C"

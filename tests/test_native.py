@@ -1,0 +1,129 @@
+import random
+
+import pytest
+
+from vnsum_tpu import native
+from vnsum_tpu.eval.rouge import PorterStemmer, RougeScorer
+from vnsum_tpu.text.splitter import RecursiveTokenSplitter
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built"
+)
+
+
+def test_stemmer_matches_python():
+    py = PorterStemmer()
+    rnd = random.Random(11)
+    words = [
+        "caresses", "ponies", "ties", "dying", "controlling", "happiness",
+        "summarization", "geologi", "beautifulli", "rate", "cease",
+    ] + [
+        "".join(rnd.choices("abcdefgilmnoprstuyz", k=rnd.randint(3, 12)))
+        for _ in range(2000)
+    ]
+    bad = [w for w in words if native.porter_stem_native(w) != py.stem(w)]
+    assert not bad, bad[:10]
+
+
+def test_rouge_matches_python_fuzz():
+    py = RougeScorer(["rouge1", "rouge2", "rougeL"], use_native=False)
+    cpp = RougeScorer(["rouge1", "rouge2", "rougeL"], use_native=True)
+    rnd = random.Random(5)
+    vocab = [
+        "tóm", "tắt", "kinh", "tế", "summary", "nation", "running", "2024",
+        "điểm", "học", "flies", "meeting", "quốc", "hội",
+    ]
+    cases = [("", ""), ("a", ""), ("", "b"), ("giống nhau", "giống nhau")]
+    for _ in range(60):
+        t = " ".join(rnd.choices(vocab, k=rnd.randint(0, 40)))
+        p = " ".join(rnd.choices(vocab, k=rnd.randint(0, 40)))
+        cases.append((t, p))
+    for t, p in cases:
+        a, b = py.score(t, p), cpp.score(t, p)
+        for key in ("rouge1", "rouge2", "rougeL"):
+            assert a[key].precision == pytest.approx(b[key].precision, abs=1e-12), (t, p, key)
+            assert a[key].recall == pytest.approx(b[key].recall, abs=1e-12)
+            assert a[key].fmeasure == pytest.approx(b[key].fmeasure, abs=1e-12)
+
+
+def test_rouge_corpus_batch():
+    targets = ["một hai ba", "bốn năm"]
+    preds = ["một hai", "bốn năm sáu"]
+    batch = native.rouge_corpus_native(targets, preds)
+    for (t, p), res in zip(zip(targets, preds), batch):
+        single = native.rouge_score_native(t, p)
+        assert res == single
+    with pytest.raises(ValueError):
+        native.rouge_corpus_native(["a"], ["b", "c"])
+
+
+def test_count_words_matches_python():
+    samples = ["", "một", "một  hai\nba\tbốn", "  lead trail  ", "x " * 50]
+    for s in samples:
+        assert native.count_words(s) == len(s.split()), repr(s)
+
+
+def test_split_matches_python_splitter():
+    rnd = random.Random(3)
+    sents = [
+        "Quốc hội thông qua nghị quyết",
+        "Chính phủ đẩy mạnh đầu tư",
+        "Người dân được hỗ trợ",
+    ]
+    for _ in range(10):
+        paras = []
+        for _ in range(rnd.randint(1, 12)):
+            paras.append(
+                ". ".join(rnd.choice(sents) for _ in range(rnd.randint(1, 6)))
+                + "."
+            )
+        text = "\n\n".join(paras)
+        for chunk, ov in [(80, 0), (120, 20), (50, 10)]:
+            py = RecursiveTokenSplitter(
+                chunk, ov, length_function=lambda s: len(s.encode("utf-8"))
+            ).split_text(text)
+            cpp = native.split_text_bytes(text, chunk, ov)
+            assert cpp == py, (chunk, ov, text[:60])
+
+
+def test_split_empty_and_oversized():
+    assert native.split_text_bytes("", 100, 0) == []
+    # an unbreakable run falls through to char splitting
+    out = native.split_text_bytes("x" * 300, 50, 0)
+    assert all(len(c.encode()) <= 50 for c in out)
+    assert "".join(out) == "x" * 300
+
+
+def test_split_oversized_multibyte_run_respects_codepoints():
+    text = "ă" * 200  # 2-byte codepoints, no separators
+    out = native.split_text_bytes(text, 51, 0)
+    assert "".join(out) == text  # decodable => no mid-codepoint cuts
+    py = RecursiveTokenSplitter(
+        51, 0, length_function=lambda s: len(s.encode("utf-8"))
+    ).split_text(text)
+    assert out == py
+
+
+def test_split_heavy_overlap_retries_buffer():
+    text = ". ".join(f"câu {i} dài" for i in range(400))
+    out = native.split_text_bytes(text, 100, 80)
+    py = RecursiveTokenSplitter(
+        100, 80, length_function=lambda s: len(s.encode("utf-8"))
+    ).split_text(text)
+    assert out == py
+
+
+def test_nul_handling():
+    with pytest.raises(ValueError):
+        native.split_text_bytes("a\x00b", 10, 0)
+    # RougeScorer transparently falls back to Python for NUL pairs
+    sc = RougeScorer(["rouge1"], use_native=True)
+    py = RougeScorer(["rouge1"], use_native=False)
+    t, p = "a b c", "a\x00b c"
+    assert sc.score(t, p)["rouge1"] == py.score(t, p)["rouge1"]
+
+
+def test_stemmer_wrapper_parity_on_case_and_unicode():
+    py = PorterStemmer()
+    assert native.porter_stem_native("Running") == py.stem("Running")
+    assert native.porter_stem_native("việc") == py.stem("việc")

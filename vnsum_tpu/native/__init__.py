@@ -1,0 +1,195 @@
+"""ctypes bindings for the C++ host core (native/vnsum_native.cpp).
+
+Loads libvnsum_native.so from the repo's native/ dir (building it on demand
+with `make` when a compiler is available) and exposes:
+
+- rouge_score_native / rouge_corpus_native — C++ ROUGE-1/2/L with the same
+  tokenizer+stemmer semantics as eval/rouge.py;
+- porter_stem_native — the NLTK-mode Porter stemmer;
+- split_text_bytes — the recursive byte-budget splitter;
+- count_words — whitespace word count.
+
+Everything degrades gracefully: `available()` is False when the library
+can't be built/loaded, and callers fall back to the Python implementations.
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+from ..core.logging import get_logger
+
+logger = get_logger("vnsum.native")
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libvnsum_native.so"
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def _try_build() -> bool:
+    if not (_NATIVE_DIR / "vnsum_native.cpp").is_file():
+        return False
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+            capture_output=True, timeout=120,
+        )
+        return _LIB_PATH.is_file()
+    except Exception as e:
+        logger.info("native build unavailable: %s", e)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    # always run make: no-op when fresh, rebuilds a stale .so after source edits
+    if not _try_build() and not _LIB_PATH.is_file():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as e:
+        logger.info("native library load failed: %s", e)
+        return None
+    lib.vn_rouge_score.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.vn_rouge_corpus.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.vn_porter_stem.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.vn_porter_stem.restype = ctypes.c_int
+    lib.vn_count_words.argtypes = [ctypes.c_char_p]
+    lib.vn_count_words.restype = ctypes.c_int
+    lib.vn_split_bytes.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+    ]
+    lib.vn_split_bytes.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _c_text(s: str) -> bytes:
+    """Encode for a NUL-terminated char*; embedded NULs would silently
+    truncate, so callers must fall back to Python for such strings."""
+    b = s.encode("utf-8")
+    if b"\x00" in b:
+        raise ValueError("text contains NUL; use the Python path")
+    return b
+
+
+def rouge_score_native(target: str, prediction: str, use_stemmer: bool = True):
+    """Returns {"rouge1"|"rouge2"|"rougeL": (precision, recall, fmeasure)}.
+    Raises ValueError for strings with embedded NULs (fall back to Python)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    out = (ctypes.c_double * 9)()
+    lib.vn_rouge_score(
+        _c_text(target), _c_text(prediction), int(use_stemmer), out,
+    )
+    vals = list(out)
+    return {
+        "rouge1": tuple(vals[0:3]),
+        "rouge2": tuple(vals[3:6]),
+        "rougeL": tuple(vals[6:9]),
+    }
+
+
+def rouge_corpus_native(
+    targets: list[str], predictions: list[str], use_stemmer: bool = True
+):
+    """Batched scoring: returns a list of per-pair dicts like
+    rouge_score_native."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(targets)
+    if n != len(predictions):
+        raise ValueError("targets and predictions must align")
+    t_arr = (ctypes.c_char_p * n)(*[_c_text(t) for t in targets])
+    p_arr = (ctypes.c_char_p * n)(*[_c_text(p) for p in predictions])
+    out = (ctypes.c_double * (9 * n))()
+    lib.vn_rouge_corpus(t_arr, p_arr, n, int(use_stemmer), out)
+    results = []
+    for i in range(n):
+        v = out[9 * i : 9 * i + 9]
+        results.append(
+            {
+                "rouge1": tuple(v[0:3]),
+                "rouge2": tuple(v[3:6]),
+                "rougeL": tuple(v[6:9]),
+            }
+        )
+    return results
+
+
+def porter_stem_native(word: str) -> str:
+    """Lowercases like PorterStemmer.stem; non-ASCII words take the Python
+    path (the rouge tokenizer never produces them, but the public API must
+    agree with the Python stemmer)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    lowered = word.lower()
+    try:
+        encoded = lowered.encode("ascii")
+    except UnicodeEncodeError:
+        from ..eval.rouge import PorterStemmer
+
+        return PorterStemmer().stem(word)
+    buf = ctypes.create_string_buffer(len(encoded) + 8)
+    n = lib.vn_porter_stem(encoded, buf, len(buf))
+    return buf.raw[:n].decode("ascii")
+
+
+def count_words(text: str) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return lib.vn_count_words(_c_text(text))
+
+
+def split_text_bytes(text: str, chunk_size: int, chunk_overlap: int) -> list[str]:
+    """Native equivalent of RecursiveTokenSplitter(...).split_text for the
+    byte-count length function. Raises ValueError for NUL-containing text."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    data = _c_text(text)
+    if not data:
+        return []
+    # overlap carry-over inflates total output; grow the buffer on demand
+    cap = max(len(data) * 2 + 4096, 1 << 16)
+    max_chunks = max(len(data), 1024)
+    for _ in range(8):
+        buf = ctypes.create_string_buffer(cap)
+        lens = (ctypes.c_int * max_chunks)()
+        n = lib.vn_split_bytes(
+            data, chunk_size, chunk_overlap, buf, cap, lens, max_chunks
+        )
+        if n >= 0:
+            raw = buf.raw
+            chunks = []
+            start = 0
+            for i in range(n):
+                chunks.append(raw[start : start + lens[i]].decode("utf-8"))
+                start += lens[i]
+            return chunks
+        cap *= 2
+        max_chunks *= 2
+    raise RuntimeError("native splitter buffer overflow after retries")
