@@ -1,0 +1,153 @@
+"""OllamaBackend behavior tests with a stubbed `requests` module — payload
+parity with the reference's OllamaLLM (SURVEY.md §2 C2) plus the retry
+policy the reference lacks (§5 "Failure detection ... No retries anywhere")."""
+import sys
+import types
+
+import pytest
+
+from vnsum_tpu.backend.ollama import OllamaBackend
+from vnsum_tpu.core.config import GenerationConfig
+
+
+class FakeResponse:
+    def __init__(self, payload=None, status=200):
+        self._payload = payload or {}
+        self.status_code = status
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise self._requests.HTTPError(response=self)
+
+    def json(self):
+        return self._payload
+
+
+@pytest.fixture()
+def fake_requests(monkeypatch):
+    mod = types.ModuleType("requests")
+
+    class ConnectionError(Exception):
+        pass
+
+    class Timeout(Exception):
+        pass
+
+    class HTTPError(Exception):
+        def __init__(self, response=None):
+            self.response = response
+
+    mod.ConnectionError = ConnectionError
+    mod.Timeout = Timeout
+    mod.HTTPError = HTTPError
+    mod.calls = []
+    mod.responses = []
+
+    def post(url, json=None, timeout=None):
+        mod.calls.append({"url": url, "json": json, "timeout": timeout})
+        item = mod.responses.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        item._requests = mod
+        return item
+
+    def get(url, timeout=None):
+        item = mod.responses.pop(0)
+        item._requests = mod
+        return item
+
+    mod.post = post
+    mod.get = get
+    monkeypatch.setitem(sys.modules, "requests", mod)
+    return mod
+
+
+def test_payload_parity(fake_requests):
+    """POST body matches the reference OllamaLLM (mapreduce.py:37-49 +
+    critique.py's think:false + num_predict option)."""
+    fake_requests.responses = [FakeResponse({"response": "<think>x</think>KQ"})]
+    be = OllamaBackend(model="llama3.2:3b", url="http://h:1/")
+    out = be.generate(["xin chào"], max_new_tokens=77)
+    assert out == ["KQ"]  # thinking tokens cleaned
+    call = fake_requests.calls[0]
+    assert call["url"] == "http://h:1/api/generate"
+    body = call["json"]
+    assert body["model"] == "llama3.2:3b"
+    assert body["prompt"] == "xin chào"
+    assert body["stream"] is False
+    assert body["think"] is False
+    assert body["options"]["num_predict"] == 77
+
+
+def test_generation_config_options(fake_requests):
+    fake_requests.responses = [FakeResponse({"response": "ok"})]
+    be = OllamaBackend()
+    cfg = GenerationConfig(temperature=0.7, top_k=40, top_p=0.9, seed=11)
+    be.generate(["p"], config=cfg)
+    opts = fake_requests.calls[0]["json"]["options"]
+    assert opts["temperature"] == 0.7
+    assert opts["top_k"] == 40
+    assert opts["top_p"] == 0.9
+    assert opts["seed"] == 11
+
+
+def test_retries_transient_then_succeeds(fake_requests, monkeypatch):
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    fake_requests.responses = [
+        fake_requests.ConnectionError("down"),
+        fake_requests.ConnectionError("still down"),
+        FakeResponse({"response": "ok"}),
+    ]
+    be = OllamaBackend(max_retries=3, retry_backoff=0)
+    assert be.generate(["p"]) == ["ok"]
+    assert len(fake_requests.calls) == 3
+
+
+def test_timeout_not_retried(fake_requests, monkeypatch):
+    """A read timeout (600 s default) is not transient — retrying it would
+    stall the pipeline ~40 min/prompt on a hung server."""
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    fake_requests.responses = [fake_requests.Timeout("hung")]
+    be = OllamaBackend(max_retries=3, retry_backoff=0)
+    with pytest.raises(fake_requests.Timeout):
+        be.generate(["p"])
+    assert len(fake_requests.calls) == 1
+
+
+def test_negative_max_retries_clamped(fake_requests):
+    fake_requests.responses = [FakeResponse({"response": "ok"})]
+    be = OllamaBackend(max_retries=-1)
+    assert be.max_retries == 0
+    assert be.generate(["p"]) == ["ok"]
+
+
+def test_retries_5xx_but_not_4xx(fake_requests, monkeypatch):
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    fake_requests.responses = [
+        FakeResponse(status=500),
+        FakeResponse({"response": "ok"}),
+    ]
+    be = OllamaBackend(max_retries=2, retry_backoff=0)
+    assert be.generate(["p"]) == ["ok"]
+
+    fake_requests.calls.clear()
+    fake_requests.responses = [FakeResponse(status=404)]
+    with pytest.raises(fake_requests.HTTPError):
+        be.generate(["p"])
+    assert len(fake_requests.calls) == 1  # no retry on client error
+
+
+def test_retries_exhausted_raises(fake_requests, monkeypatch):
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    fake_requests.responses = [fake_requests.ConnectionError("down")] * 3
+    be = OllamaBackend(max_retries=2, retry_backoff=0)
+    with pytest.raises(fake_requests.ConnectionError):
+        be.generate(["p"])
+    assert len(fake_requests.calls) == 3
+
+
+def test_health_check(fake_requests):
+    fake_requests.responses = [
+        FakeResponse({"models": [{"name": "llama3.2:3b"}, {"name": "qwen3:8b"}]})
+    ]
+    assert OllamaBackend().health_check() == ["llama3.2:3b", "qwen3:8b"]

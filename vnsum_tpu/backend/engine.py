@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
+from ..core.profiling import annotate
 from ..models.llama import (
     LlamaConfig,
     decode_attention_mask,
@@ -259,7 +260,8 @@ class TpuBackend:
                 tokens[row, S - len(ids) :] = ids  # left padding
                 pad_lens[row] = S - len(ids)
             fn = self._get_fn(B, S, max_new, gen)
-            out = np.asarray(fn(self.params, tokens, pad_lens, self._seed))
+            with annotate(f"generate[B={B},S={S}]"):
+                out = np.asarray(fn(self.params, tokens, pad_lens, self._seed))
             self.stats.batches += 1
             self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
             for row, i in enumerate(group):

@@ -11,11 +11,15 @@ multi-worker Ollama server actually sees concurrent requests.
 """
 from __future__ import annotations
 
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..core.config import GenerationConfig
+from ..core.logging import get_logger
 from ..text.cleaning import clean_thinking_tokens
 from ..text.tokenizer import whitespace_token_count
+
+logger = get_logger("vnsum.backend.ollama")
 
 
 class OllamaBackend:
@@ -29,6 +33,8 @@ class OllamaBackend:
         timeout: float = 600.0,
         clean_output: bool = True,
         concurrency: int = 4,
+        max_retries: int = 3,
+        retry_backoff: float = 1.0,
     ) -> None:
         self.model = model
         self.url = url.rstrip("/")
@@ -36,6 +42,8 @@ class OllamaBackend:
         self.timeout = timeout
         self.clean_output = clean_output
         self.concurrency = concurrency
+        self.max_retries = max(0, max_retries)
+        self.retry_backoff = retry_backoff
 
     def health_check(self) -> list[str]:
         """GET /api/tags; returns available model names
@@ -65,12 +73,36 @@ class OllamaBackend:
             "think": False,
             "options": options,
         }
-        resp = requests.post(
-            f"{self.url}/api/generate", json=payload, timeout=self.timeout
-        )
-        resp.raise_for_status()
-        text = resp.json()["response"]
-        return clean_thinking_tokens(text) if self.clean_output else text
+        # retry transient failures with exponential backoff — the reference
+        # has no retries anywhere (SURVEY.md §5 "Failure detection"), so one
+        # dropped connection voids a whole document there
+        last_exc: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                resp = requests.post(
+                    f"{self.url}/api/generate", json=payload, timeout=self.timeout
+                )
+                resp.raise_for_status()
+                text = resp.json()["response"]
+                return clean_thinking_tokens(text) if self.clean_output else text
+            except requests.ConnectionError as e:
+                # NOT requests.Timeout: with the 600 s read timeout a hung
+                # server would stall ~40 min/prompt across retries
+                last_exc = e
+            except requests.HTTPError as e:
+                status = e.response.status_code if e.response is not None else 0
+                # 5xx, 429 (load shed), 408 (request timeout) are transient
+                if status < 500 and status not in (408, 429):
+                    raise
+                last_exc = e
+            if attempt < self.max_retries:
+                delay = self.retry_backoff * (2**attempt)
+                logger.warning(
+                    "ollama call failed (%s); retry %d/%d in %.1fs",
+                    last_exc, attempt + 1, self.max_retries, delay,
+                )
+                time.sleep(delay)
+        raise last_exc  # type: ignore[misc]
 
     def generate(
         self,

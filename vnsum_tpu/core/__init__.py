@@ -6,9 +6,13 @@ from .config import (
     approach_defaults,
 )
 from .logging import get_logger, setup_run_logging
+from .profiling import Tracer, annotate, device_profile
 from .results import DocumentRecord, ModelRunRecord, PipelineResults
 
 __all__ = [
+    "Tracer",
+    "annotate",
+    "device_profile",
     "ApproachName",
     "EvalConfig",
     "GenerationConfig",

@@ -1,0 +1,129 @@
+"""Tracing / profiling subsystem.
+
+The reference has no profiler integration — only LangSmith `@traceable` on one
+driver (runners/run_summarization_ollama_mapreduce_critique.py:21,403, active
+only when LangSmith env vars are set) and manual wall-clock spans stored in the
+run record (run_full_evaluation_pipeline.py:439,572-591). This module keeps
+those capabilities and makes them first-class:
+
+- `Tracer.span(name)` — nested wall-clock spans with aggregated statistics,
+  thread-safe (strategy batches may fan out over a thread pool), persisted in
+  the structured run record instead of log lines.
+- `device_profile(log_dir)` — `jax.profiler.trace` wrapper producing TensorBoard
+  / Perfetto traces of the on-device work (the TPU-native analog of the
+  reference's LangSmith tracing). Gated: no-op unless a directory is given or
+  `VNSUM_PROFILE_DIR` is set, mirroring the reference's env-gated LangSmith
+  activation (...critique.py:22-23).
+- `annotate(name)` — `jax.profiler.TraceAnnotation` passthrough so host-side
+  phases show up inside device traces.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SpanStats:
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = float("inf")
+    max_s: float = 0.0
+
+    def add(self, duration: float) -> None:
+        self.count += 1
+        self.total_s += duration
+        self.min_s = min(self.min_s, duration)
+        self.max_s = max(self.max_s, duration)
+
+    def to_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "total_s": self.total_s,
+            "mean_s": self.total_s / self.count if self.count else 0.0,
+            "min_s": self.min_s if self.count else 0.0,
+            "max_s": self.max_s,
+        }
+
+
+@dataclass
+class Tracer:
+    """Aggregating wall-clock tracer.
+
+    Span names are hierarchical: nested spans get `parent/child` keys, so the
+    run record shows e.g. `summarize/batch` under `summarize`. One Tracer is
+    shared per pipeline run; use `reset()` between runs.
+    """
+
+    _stats: dict[str, SpanStats] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _local: threading.local = field(default_factory=threading.local)
+
+    def _stack(self) -> list[str]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        stack = self._stack()
+        full = "/".join([*stack, name])
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            duration = time.perf_counter() - t0
+            stack.pop()
+            with self._lock:
+                self._stats.setdefault(full, SpanStats()).add(duration)
+
+    def record(self, name: str, duration: float) -> None:
+        """Record an externally-timed span (e.g. a device-side step time)."""
+        with self._lock:
+            self._stats.setdefault(name, SpanStats()).add(duration)
+
+    def stats(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: v.to_dict() for k, v in sorted(self._stats.items())}
+
+    def to_dict(self) -> dict:
+        return {"spans": self.stats()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+@contextlib.contextmanager
+def device_profile(log_dir: str | None = None):
+    """Capture a JAX device profile for the enclosed block.
+
+    `log_dir` falls back to `$VNSUM_PROFILE_DIR`; when neither is set this is
+    a no-op, so production paths can wrap their hot sections unconditionally.
+    View with TensorBoard (`tensorboard --logdir <dir>`) or Perfetto.
+    """
+    log_dir = log_dir or os.environ.get("VNSUM_PROFILE_DIR")
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region inside a device trace (XPlane TraceMe annotation)."""
+    try:
+        import jax
+
+        cm = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - jax always present in this image
+        cm = contextlib.nullcontext()
+    with cm:
+        yield

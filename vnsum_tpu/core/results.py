@@ -69,6 +69,7 @@ class PipelineResults:
     document_stats: dict = field(default_factory=dict)
     summarization: dict[str, Any] = field(default_factory=dict)
     evaluation: dict[str, Any] = field(default_factory=dict)
+    tracing: dict[str, Any] = field(default_factory=dict)
 
     def add_summarization(self, record: ModelRunRecord) -> None:
         self.summarization[record.model] = record.to_dict()
@@ -92,6 +93,7 @@ class PipelineResults:
                 "document_stats": self.document_stats,
                 "summarization": self.summarization,
                 "evaluation": self.evaluation,
+                "tracing": self.tracing,
             },
         }
 

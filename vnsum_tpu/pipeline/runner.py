@@ -10,6 +10,7 @@ device batches.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 import traceback
 from pathlib import Path
@@ -17,6 +18,7 @@ from pathlib import Path
 from ..backend.base import Backend, get_backend
 from ..core.config import PipelineConfig
 from ..core.logging import get_logger, setup_run_logging
+from ..core.profiling import Tracer, device_profile
 from ..core.results import DocumentRecord, ModelRunRecord, PipelineResults
 from ..data import DocumentDataset, analyze_documents
 from ..eval import SemanticEvaluator
@@ -42,6 +44,7 @@ class PipelineRunner:
         self.backend_factory = backend_factory or self._default_backend_factory
         self.embedding_model = embedding_model
         self.results = PipelineResults(config=config.to_dict())
+        self.tracer = Tracer()
         self.log_path = setup_run_logging(config.logs_dir)
         logger.info("pipeline configured: approach=%s backend=%s models=%s",
                     config.approach, config.backend, config.models)
@@ -168,27 +171,34 @@ class PipelineRunner:
         for start in range(0, len(pending), group_size):
             group = pending[start : start + group_size]
             batch_t0 = time.time()
+            # profiler windows must stay short: capture the first batch only
+            profile_cm = device_profile() if start == 0 else contextlib.nullcontext()
             try:
-                if cfg.approach == "mapreduce_hierarchical" and tree is not None:
-                    roots, docs_fallback = [], []
-                    for name in group:
-                        node = tree.get(name)
-                        if node is None:
-                            docs_fallback.append(name)
-                        roots.append((name, node))
-                    results = []
-                    tree_items = [(n, r) for n, r in roots if r is not None]
-                    if tree_items:
-                        tree_results = strategy.summarize_tree_batch(
-                            [r for _, r in tree_items]
-                        )
-                        results.extend(zip([n for n, _ in tree_items], tree_results))
-                    if docs_fallback:
-                        texts = [ds.read_doc(n) for n in docs_fallback]
-                        results.extend(zip(docs_fallback, strategy.summarize_batch(texts)))
-                else:
-                    texts = [ds.read_doc(n) for n in group]
-                    results = list(zip(group, strategy.summarize_batch(texts)))
+                with self.tracer.span("batch"), profile_cm:
+                    if cfg.approach == "mapreduce_hierarchical" and tree is not None:
+                        roots, docs_fallback = [], []
+                        for name in group:
+                            node = tree.get(name)
+                            if node is None:
+                                docs_fallback.append(name)
+                            roots.append((name, node))
+                        results = []
+                        tree_items = [(n, r) for n, r in roots if r is not None]
+                        if tree_items:
+                            tree_results = strategy.summarize_tree_batch(
+                                [r for _, r in tree_items]
+                            )
+                            results.extend(
+                                zip([n for n, _ in tree_items], tree_results)
+                            )
+                        if docs_fallback:
+                            texts = [ds.read_doc(n) for n in docs_fallback]
+                            results.extend(
+                                zip(docs_fallback, strategy.summarize_batch(texts))
+                            )
+                    else:
+                        texts = [ds.read_doc(n) for n in group]
+                        results = list(zip(group, strategy.summarize_batch(texts)))
             except Exception as e:
                 logger.error("batch failed (%s): %s", group, e)
                 logger.debug("%s", traceback.format_exc())
@@ -281,10 +291,12 @@ class PipelineRunner:
     # -- orchestration -----------------------------------------------------
 
     def run(self) -> PipelineResults:
-        self.analyze()
+        with self.tracer.span("analyze"):
+            self.analyze()
         for model in self.config.models:
             try:
-                self.run_summarization_for_model(model)
+                with self.tracer.span("summarize"):
+                    self.run_summarization_for_model(model)
             except Exception as e:
                 logger.error("model %s summarization failed: %s", model, e)
                 logger.debug("%s", traceback.format_exc())
@@ -295,10 +307,12 @@ class PipelineRunner:
                 self.results.add_summarization(rec)
                 continue
             try:
-                self.run_evaluation_for_model(model)
+                with self.tracer.span("evaluate"):
+                    self.run_evaluation_for_model(model)
             except Exception as e:
                 logger.error("model %s evaluation failed: %s", model, e)
                 self.results.add_evaluation(model, {"status": "failed", "error": str(e)})
+        self.results.tracing = self.tracer.to_dict()
         path = self.results.save(self.config.results_dir)
         logger.info("results saved to %s", path)
         self.report()
