@@ -1,0 +1,136 @@
+"""Demo tests: the side-by-side approach runner and the stdlib web server
+(capability match for the reference's streamlit_demo.py, SURVEY.md §2 C14),
+driven over a live ThreadingHTTPServer with the FakeBackend."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vnsum_tpu.backend.fake import FakeBackend
+from vnsum_tpu.core.config import APPROACHES
+from vnsum_tpu.demo.core import compute_metrics, run_approaches
+from vnsum_tpu.demo.server import DemoState, make_server
+
+DOC = "\n\n".join(
+    f"Đoạn văn {i}: " + "nội dung tiếng Việt có dấu thanh. " * 25
+    for i in range(5)
+)
+REF = "Tóm tắt: nội dung tiếng Việt có dấu thanh."
+
+
+def test_run_all_approaches():
+    runs = run_approaches(DOC, FakeBackend(), reference=REF)
+    assert [r.approach for r in runs] == list(APPROACHES)
+    for r in runs:
+        assert r.status == "success", f"{r.approach}: {r.error}"
+        assert r.summary
+        assert r.metrics["rouge1"] > 0
+        assert r.seconds >= 0
+
+
+def test_run_subset_and_progress():
+    seen = []
+    runs = run_approaches(
+        DOC, FakeBackend(), approaches=["truncated", "mapreduce"],
+        progress=lambda i, n, name: seen.append((i, n, name)),
+    )
+    assert [r.approach for r in runs] == ["truncated", "mapreduce"]
+    assert seen == [(0, 2, "truncated"), (1, 2, "mapreduce")]
+    # no reference -> no metrics
+    assert runs[0].metrics == {}
+
+
+def test_one_failure_does_not_kill_the_rest():
+    class ExplodingBackend(FakeBackend):
+        def generate(self, prompts, **kw):
+            raise RuntimeError("boom")
+
+    runs = run_approaches(DOC, ExplodingBackend(),
+                          approaches=["mapreduce", "truncated"])
+    assert all(r.status == "failed" for r in runs)
+    assert all(r.error for r in runs)
+
+
+def test_compute_metrics_identity():
+    m = compute_metrics(REF, REF)
+    assert m["rouge1"] == pytest.approx(1.0)
+    assert set(m) == {"rouge1", "rouge2", "rougeL"}
+
+
+@pytest.fixture()
+def demo_server(tmp_path):
+    docs = tmp_path / "doc"
+    refs = tmp_path / "summary"
+    docs.mkdir()
+    refs.mkdir()
+    (docs / "sample.txt").write_text(DOC, encoding="utf-8")
+    (refs / "sample.txt").write_text(REF, encoding="utf-8")
+
+    from vnsum_tpu.data import DocumentDataset
+
+    state = DemoState(FakeBackend(), DocumentDataset(docs, refs))
+    server = make_server(state, "127.0.0.1", 0)  # ephemeral port
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    server.server_close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_server_index(demo_server):
+    status, body = _get(demo_server + "/")
+    assert status == 200
+    assert b"VN-LongSum" in body
+    for a in APPROACHES:
+        assert a.encode() in body
+
+
+def test_server_docs_listing_and_fetch(demo_server):
+    status, body = _get(demo_server + "/api/docs")
+    assert status == 200 and json.loads(body) == {"docs": ["sample.txt"]}
+    status, body = _get(demo_server + "/api/doc?name=sample.txt")
+    d = json.loads(body)
+    assert d["text"].startswith("Đoạn văn 0")
+    assert d["reference"] == REF
+
+
+def test_server_summarize(demo_server):
+    status, d = _post(
+        demo_server + "/api/summarize",
+        {"text": DOC, "reference": REF, "approaches": ["mapreduce", "truncated"]},
+    )
+    assert status == 200
+    assert [r["approach"] for r in d["runs"]] == ["mapreduce", "truncated"]
+    for r in d["runs"]:
+        assert r["status"] == "success"
+        assert r["summary"]
+        assert r["metrics"]["rouge1"] > 0
+
+
+def test_server_rejects_bad_requests(demo_server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(demo_server + "/api/summarize", {"text": "   "})
+    assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(demo_server + "/api/summarize",
+              {"text": "x", "approaches": ["nope"]})
+    assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(demo_server + "/api/doc?name=missing.txt")
+    assert exc.value.code == 404

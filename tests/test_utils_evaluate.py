@@ -1,0 +1,87 @@
+"""Tests for the simple folder-vs-folder evaluator CLI (capability match for
+the reference's utils/evaluate_summaries.py:27-106, SURVEY.md §2 C10)."""
+import json
+
+import pytest
+
+from vnsum_tpu.eval import EmbeddingModel
+from vnsum_tpu.models.encoder import tiny_encoder
+from vnsum_tpu.utils.evaluate_summaries import (
+    evaluate_summaries,
+    format_report,
+    main,
+)
+
+
+@pytest.fixture()
+def folders(tmp_path):
+    gen = tmp_path / "gen"
+    ref = tmp_path / "ref"
+    gen.mkdir()
+    ref.mkdir()
+    pairs = {
+        "a.txt": ("tóm tắt văn bản một", "tóm tắt văn bản một"),
+        "b.txt": ("nội dung hoàn toàn khác", "tóm tắt văn bản hai"),
+    }
+    for name, (g, r) in pairs.items():
+        (gen / name).write_text(g, encoding="utf-8")
+        (ref / name).write_text(r, encoding="utf-8")
+    (gen / "unpaired.txt").write_text("không có tham chiếu", encoding="utf-8")
+    return gen, ref
+
+
+def test_rouge_only(folders):
+    gen, ref = folders
+    res = evaluate_summaries(gen, ref, skip_bert=True)
+    assert res["num_pairs"] == 2  # unpaired file skipped
+    # a.txt is identical -> perfect rouge1
+    assert res["per_file"]["a.txt"]["rouge1"]["f1"] == pytest.approx(1.0)
+    agg = res["aggregate"]
+    assert set(agg) == {"rouge1", "rouge2", "rougeL"}
+    assert 0.0 < agg["rouge1"]["f1"] <= 1.0
+
+
+def test_with_bert_scores(folders):
+    gen, ref = folders
+    embedder = EmbeddingModel(config=tiny_encoder(), max_len=32, batch_size=2)
+    res = evaluate_summaries(gen, ref, embedding_model=embedder)
+    assert "bert" in res["aggregate"]
+    assert "bert" in res["per_file"]["a.txt"]
+    # identical pair must score at least as high as the mismatched pair
+    assert (
+        res["per_file"]["a.txt"]["bert"]["f1"]
+        >= res["per_file"]["b.txt"]["bert"]["f1"]
+    )
+
+
+def test_empty_intersection_raises(tmp_path):
+    gen = tmp_path / "gen"
+    ref = tmp_path / "ref"
+    gen.mkdir()
+    ref.mkdir()
+    (gen / "x.txt").write_text("a")
+    (ref / "y.txt").write_text("b")
+    with pytest.raises(ValueError, match="no matching filenames"):
+        evaluate_summaries(gen, ref, skip_bert=True)
+
+
+def test_cli_main_writes_output(folders, tmp_path, capsys):
+    gen, ref = folders
+    out = tmp_path / "results" / "eval.json"
+    rc = main([str(gen), str(ref), "--skip-bert", "--output", str(out)])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "Evaluated 2 summary pairs" in printed
+    assert "rouge1" in printed
+    data = json.loads(out.read_text())
+    assert data["num_pairs"] == 2
+    assert "aggregate" in data and "per_file" in data
+
+
+def test_format_report_shows_all_metrics(folders):
+    gen, ref = folders
+    res = evaluate_summaries(gen, ref, skip_bert=True, max_samples=1)
+    assert res["num_pairs"] == 1
+    report = format_report(res)
+    for m in ("rouge1", "rouge2", "rougeL"):
+        assert m in report

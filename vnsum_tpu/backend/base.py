@@ -33,7 +33,7 @@ class Backend(Protocol):
 
 
 def get_backend(spec: str, **kwargs) -> Backend:
-    """Factory: "fake", "ollama", or "tpu"."""
+    """Factory: "fake", "ollama", "tpu", or "hf"."""
     if spec == "fake":
         from .fake import FakeBackend
 
@@ -46,4 +46,8 @@ def get_backend(spec: str, **kwargs) -> Backend:
         from .engine import TpuBackend
 
         return TpuBackend(**kwargs)
-    raise ValueError(f"unknown backend {spec!r} (use tpu|ollama|fake)")
+    if spec == "hf":
+        from .hf import HFBackend
+
+        return HFBackend(**kwargs)
+    raise ValueError(f"unknown backend {spec!r} (use tpu|ollama|hf|fake)")

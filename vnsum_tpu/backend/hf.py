@@ -1,0 +1,155 @@
+"""HF transformers (torch) backend — capability match for the reference's
+only direct-ML execution path, `runners/run_summarization.py:17-62` (SURVEY.md
+§2 C8): AutoModelForCausalLM + chat template with thinking disabled, greedy
+`model.generate`, input truncated to `max_context - max_new_tokens`.
+
+In this framework it serves two roles:
+- a CPU/GPU parity oracle for the JAX engine (same prompts, greedy decode,
+  comparable outputs), and
+- a fallback backend on hosts without TPU access.
+
+Torch and transformers are imported lazily so the rest of the framework never
+pays for them; models must already be on disk (zero-egress hosts have no HF
+hub access).
+"""
+from __future__ import annotations
+
+from ..core.config import GenerationConfig
+from ..core.logging import get_logger
+from ..text.cleaning import clean_thinking_tokens
+
+logger = get_logger("vnsum.backend.hf")
+
+
+class HFBackend:
+    name = "hf"
+
+    def __init__(
+        self,
+        model_name_or_path: str,
+        *,
+        model=None,
+        tokenizer=None,
+        max_context: int = 16384,
+        max_new_tokens: int = 1024,
+        device: str = "cpu",
+        use_chat_template: bool = True,
+        clean_output: bool = True,
+        torch_dtype=None,
+    ) -> None:
+        import torch
+        from transformers import AutoModelForCausalLM, AutoTokenizer
+
+        self._torch = torch
+        self.model_name = model_name_or_path
+        self.max_context = max_context
+        self.max_new_tokens = max_new_tokens
+        self.device = device
+        self.use_chat_template = use_chat_template
+        self.clean_output = clean_output
+
+        # injectable for tests / pre-loaded models (no hub access on TPU hosts)
+        self.tokenizer = tokenizer or AutoTokenizer.from_pretrained(
+            model_name_or_path
+        )
+        if model is None:
+            model = AutoModelForCausalLM.from_pretrained(
+                model_name_or_path,
+                torch_dtype=torch_dtype or torch.float32,
+            )
+        self.model = model.to(device).eval()
+        if self.tokenizer.pad_token_id is None:
+            self.tokenizer.pad_token = self.tokenizer.eos_token
+
+    def _render(self, prompt: str) -> str:
+        """Chat template with thinking disabled (ref :29-39,
+        enable_thinking=False); plain passthrough when the tokenizer has no
+        template or templating is off."""
+        if not self.use_chat_template:
+            return prompt
+        if getattr(self.tokenizer, "chat_template", None) is None:
+            return prompt
+        try:
+            return self.tokenizer.apply_chat_template(
+                [{"role": "user", "content": prompt}],
+                tokenize=False,
+                add_generation_prompt=True,
+                enable_thinking=False,
+            )
+        except TypeError:  # template without enable_thinking support
+            return self.tokenizer.apply_chat_template(
+                [{"role": "user", "content": prompt}],
+                tokenize=False,
+                add_generation_prompt=True,
+            )
+
+    def generate(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+    ) -> list[str]:
+        torch = self._torch
+        max_new = max_new_tokens or (
+            config.max_new_tokens if config else self.max_new_tokens
+        )
+        max_input = self.max_context - max_new  # ref :40-43
+        if max_input <= 0:
+            raise ValueError(
+                f"max_new_tokens={max_new} must be < max_context={self.max_context}"
+            )
+        if not prompts:
+            return []
+
+        # truncate the raw prompt BEFORE templating (ref :40-43 truncates the
+        # document first) — right-truncating the rendered string would cut the
+        # template's assistant-generation suffix and the model would continue
+        # the user turn instead of summarizing
+        overhead = (
+            len(self.tokenizer.encode(self._render("")))
+            if self.use_chat_template
+            else 0
+        )
+        budget = max(max_input - overhead, 1)
+        clipped = []
+        for p in prompts:
+            ids = self.tokenizer.encode(p)
+            if len(ids) > budget:
+                p = self.tokenizer.decode(
+                    ids[:budget], skip_special_tokens=True
+                )
+            clipped.append(p)
+        rendered = [self._render(p) for p in clipped]
+        enc = self.tokenizer(
+            rendered,
+            return_tensors="pt",
+            padding=True,
+            truncation=True,
+            max_length=max_input,
+            padding_side="left",
+        ).to(self.device)
+
+        do_sample = config is not None and config.temperature > 0.0
+        kwargs: dict = {
+            "max_new_tokens": max_new,
+            "do_sample": do_sample,  # greedy default, ref :44
+            "pad_token_id": self.tokenizer.pad_token_id,
+        }
+        if do_sample:
+            kwargs["temperature"] = config.temperature
+            if config.top_k > 0:
+                kwargs["top_k"] = config.top_k
+            if config.top_p < 1.0:
+                kwargs["top_p"] = config.top_p
+
+        with torch.no_grad():
+            out = self.model.generate(**enc, **kwargs)
+        new_tokens = out[:, enc["input_ids"].shape[1] :]
+        texts = self.tokenizer.batch_decode(new_tokens, skip_special_tokens=True)
+        if self.clean_output:
+            texts = [clean_thinking_tokens(t) for t in texts]
+        return [t.strip() for t in texts]
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.tokenizer.encode(text))

@@ -1,0 +1,3 @@
+from .core import ApproachRun, run_approaches
+
+__all__ = ["ApproachRun", "run_approaches"]

@@ -1,0 +1,92 @@
+"""Demo core: run the five approaches side-by-side over one document and
+score each against an optional reference — the compute behind both demo
+frontends (web server + streamlit), mirroring the reference's
+streamlit_demo.py:61-161 (_summarise_async dispatch + compute_metrics).
+
+Unlike the reference (one fixed Ollama model, approaches run serially over a
+sync-over-async shim, streamlit_demo.py:164-180), the approaches here share
+one Backend, and each approach's map rounds batch all chunks into single
+device calls already, so no async juggling is needed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+
+from ..backend.base import Backend
+from ..core.config import APPROACHES, PipelineConfig, approach_defaults
+from ..eval.rouge import RougeScorer
+from ..strategies import get_strategy
+from ..text import clean_thinking_tokens
+
+
+@dataclass
+class ApproachRun:
+    approach: str
+    summary: str = ""
+    num_chunks: int = 0
+    llm_calls: int = 0
+    seconds: float = 0.0
+    status: str = "success"
+    error: str | None = None
+    metrics: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "approach": self.approach,
+            "summary": self.summary,
+            "num_chunks": self.num_chunks,
+            "llm_calls": self.llm_calls,
+            "seconds": self.seconds,
+            "status": self.status,
+            "error": self.error,
+            "metrics": self.metrics,
+        }
+
+
+def compute_metrics(summary: str, reference: str) -> dict:
+    """ROUGE-1/2/L F1 vs the reference summary (streamlit_demo.py:61-79;
+    BERTScore is left to the full evaluator — the demo stays encoder-free so
+    it answers interactively)."""
+    scorer = RougeScorer(["rouge1", "rouge2", "rougeL"])
+    scores = scorer.score(reference, summary)
+    return {name: s.fmeasure for name, s in scores.items()}
+
+
+def run_approaches(
+    text: str,
+    backend: Backend,
+    *,
+    approaches: list[str] | None = None,
+    reference: str | None = None,
+    base_config: PipelineConfig | None = None,
+    progress=None,
+) -> list[ApproachRun]:
+    """Run each approach on `text`; `progress(i, n, name)` is called before
+    each one (the reference's progress bar hook, streamlit_demo.py:230-240)."""
+    chosen = list(approaches or APPROACHES)
+    runs: list[ApproachRun] = []
+    for i, name in enumerate(chosen):
+        if progress:
+            progress(i, len(chosen), name)
+        run = ApproachRun(approach=name)
+        t0 = time.time()
+        try:
+            if base_config is not None:
+                cfg = dataclasses.replace(base_config, approach=name)
+            else:
+                cfg = PipelineConfig(approach=name, **approach_defaults(name))
+            strategy = get_strategy(name, backend, cfg)
+            result = strategy.summarize(text)
+            run.summary = clean_thinking_tokens(result.summary)
+            run.num_chunks = result.num_chunks
+            run.llm_calls = result.llm_calls
+            if reference:
+                run.metrics = compute_metrics(run.summary, reference)
+        except Exception as e:  # one approach failing must not kill the rest
+            run.status = "failed"
+            run.error = str(e)
+        run.seconds = time.time() - t0
+        runs.append(run)
+    return runs

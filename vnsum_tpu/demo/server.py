@@ -1,0 +1,226 @@
+"""Dependency-free web demo — the interactive side-by-side comparison the
+reference provides via Streamlit (streamlit_demo.py:183-287, SURVEY.md §2
+C14), served from the stdlib so it runs on TPU hosts without extra packages.
+
+Single page: paste or pick a document, choose approaches, submit; the page
+renders each approach's summary with chunk/LLM-call/time stats and ROUGE vs
+the reference summary when one is given.
+
+    python -m vnsum_tpu.demo.server --backend fake --port 8900
+    python -m vnsum_tpu.demo.server --backend tpu --model llama3.2:3b
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from ..backend.base import Backend, get_backend
+from ..core.config import APPROACHES
+from ..core.logging import get_logger
+from ..data import DocumentDataset
+from .core import run_approaches
+
+logger = get_logger("vnsum.demo")
+
+_PAGE = """<!DOCTYPE html>
+<html lang="vi"><head><meta charset="utf-8">
+<title>VN-LongSum TPU demo</title>
+<style>
+body{font-family:system-ui,sans-serif;max-width:960px;margin:2rem auto;padding:0 1rem;color:#222}
+textarea{width:100%;font-family:inherit}
+.approach{border:1px solid #ccc;border-radius:8px;padding:1rem;margin:1rem 0}
+.approach h3{margin-top:0}
+.meta{color:#666;font-size:.85rem}
+.failed{border-color:#c00}
+button{padding:.5rem 1.5rem;font-size:1rem}
+label{margin-right:1rem}
+#status{color:#06c}
+</style></head><body>
+<h1>VN-LongSum TPU — so sánh 5 chiến lược tóm tắt</h1>
+<p>Dán văn bản (hoặc chọn tài liệu mẫu nếu server có dataset), chọn chiến
+lược, bấm <b>Tóm tắt</b>.</p>
+<div id="picker"></div>
+<p><textarea id="doc" rows="10" placeholder="Văn bản cần tóm tắt…"></textarea></p>
+<p><textarea id="ref" rows="3" placeholder="Tóm tắt tham chiếu (tuỳ chọn, để tính ROUGE)…"></textarea></p>
+<p id="boxes"></p>
+<p><button onclick="run()">Tóm tắt</button> <span id="status"></span></p>
+<div id="out"></div>
+<script>
+const APPROACHES = %APPROACHES%;
+const esc = s => String(s).replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+document.getElementById('boxes').innerHTML = APPROACHES.map(a =>
+  `<label><input type="checkbox" name="ap" value="${esc(a)}" checked>${esc(a)}</label>`).join('');
+fetch('api/docs').then(r=>r.json()).then(d=>{
+  if(!d.docs.length) return;
+  document.getElementById('picker').innerHTML =
+    '<select id="docsel"><option value="">— tài liệu mẫu —</option>'+
+    d.docs.map(n=>`<option>${esc(n)}</option>`).join('')+'</select>';
+  document.getElementById('docsel').onchange = e=>{
+    if(!e.target.value) return;
+    fetch('api/doc?name='+encodeURIComponent(e.target.value)).then(r=>r.json())
+      .then(d=>{document.getElementById('doc').value=d.text;
+                document.getElementById('ref').value=d.reference||'';});
+  };
+});
+function run(){
+  const text = document.getElementById('doc').value.trim();
+  if(!text){alert('Chưa có văn bản');return;}
+  const approaches=[...document.querySelectorAll('input[name=ap]:checked')].map(c=>c.value);
+  document.getElementById('status').textContent='Đang tóm tắt…';
+  document.getElementById('out').innerHTML='';
+  fetch('api/summarize',{method:'POST',headers:{'Content-Type':'application/json'},
+    body:JSON.stringify({text,reference:document.getElementById('ref').value.trim(),approaches})})
+  .then(r=>r.json()).then(d=>{
+    document.getElementById('status').textContent='';
+    document.getElementById('out').innerHTML=d.runs.map(r=>{
+      const m=r.metrics&&Object.keys(r.metrics).length?
+        '<div class="meta">ROUGE-1/2/L: '+['rouge1','rouge2','rougeL']
+          .map(k=>r.metrics[k].toFixed(4)).join(' / ')+'</div>':'';
+      const body=r.status==='success'?`<p>${esc(r.summary)}</p>`:`<p>Lỗi: ${esc(r.error)}</p>`;
+      return `<div class="approach ${r.status==='failed'?'failed':''}">
+        <h3>${esc(r.approach)}</h3>${body}
+        <div class="meta">${r.num_chunks} chunks · ${r.llm_calls} LLM calls · ${r.seconds.toFixed(1)}s</div>${m}</div>`;
+    }).join('');
+  }).catch(e=>{document.getElementById('status').textContent='Lỗi: '+e;});
+}
+</script></body></html>"""
+
+
+class DemoState:
+    def __init__(self, backend: Backend, dataset: DocumentDataset | None = None):
+        self.backend = backend
+        self.dataset = dataset
+        # backends are not thread-safe (jit caches, stats, torch modules);
+        # ThreadingHTTPServer keeps the UI responsive while summarize
+        # requests serialize here
+        self.generate_lock = threading.Lock()
+
+
+def make_handler(state: DemoState):
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, payload: dict, status: int = 200) -> None:
+            body = json.dumps(payload, ensure_ascii=False).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+            path, _, query = self.path.partition("?")
+            if path in ("/", "/index.html"):
+                page = _PAGE.replace("%APPROACHES%", json.dumps(list(APPROACHES)))
+                body = page.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/api/docs":
+                names = state.dataset.filenames() if state.dataset else []
+                self._json({"docs": names})
+            elif path == "/api/doc":
+                params = dict(
+                    p.split("=", 1) for p in query.split("&") if "=" in p
+                )
+                from urllib.parse import unquote
+
+                name = unquote(params.get("name", ""))
+                if state.dataset is None or name not in state.dataset.filenames():
+                    self._json({"error": "unknown document"}, 404)
+                    return
+                ref = ""
+                if state.dataset.has_reference(name):
+                    ref = state.dataset.read_reference(name)
+                self._json(
+                    {"text": state.dataset.read_doc(name), "reference": ref}
+                )
+            else:
+                self._json({"error": "not found"}, 404)
+
+        def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+            if self.path != "/api/summarize":
+                self._json({"error": "not found"}, 404)
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+                text = req.get("text", "")
+                if not text.strip():
+                    self._json({"error": "empty document"}, 400)
+                    return
+                approaches = req.get("approaches") or None
+                if approaches is not None:
+                    bad = [a for a in approaches if a not in APPROACHES]
+                    if bad:
+                        self._json({"error": f"unknown approaches: {bad}"}, 400)
+                        return
+                with state.generate_lock:
+                    runs = run_approaches(
+                        text,
+                        state.backend,
+                        approaches=approaches,
+                        reference=req.get("reference") or None,
+                    )
+                self._json({"runs": [r.to_dict() for r in runs]})
+            except json.JSONDecodeError:
+                self._json({"error": "invalid JSON"}, 400)
+            except Exception as e:  # surface, don't crash the server
+                logger.exception("summarize failed")
+                self._json({"error": str(e)}, 500)
+
+        def log_message(self, fmt, *args):  # route through our logger
+            logger.info("%s %s", self.address_string(), fmt % args)
+
+    return Handler
+
+
+def make_server(
+    state: DemoState, host: str = "127.0.0.1", port: int = 8900
+) -> ThreadingHTTPServer:
+    return ThreadingHTTPServer((host, port), make_handler(state))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="vnsum-demo")
+    p.add_argument("--backend", choices=["tpu", "ollama", "hf", "fake"],
+                   default="fake")
+    p.add_argument("--model", default="llama3.2:3b")
+    p.add_argument("--docs-dir", default="data_1/doc")
+    p.add_argument("--summary-dir", default="data_1/summary")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8900)
+    args = p.parse_args(argv)
+
+    if args.backend == "tpu":
+        from ..models import MODEL_REGISTRY
+
+        backend = get_backend("tpu", model_config=MODEL_REGISTRY[args.model]())
+    elif args.backend == "ollama":
+        backend = get_backend("ollama", model=args.model)
+    elif args.backend == "hf":
+        backend = get_backend("hf", model_name_or_path=args.model)
+    else:
+        backend = get_backend("fake")
+
+    dataset = None
+    if Path(args.docs_dir).is_dir():
+        dataset = DocumentDataset(args.docs_dir, args.summary_dir)
+    state = DemoState(backend, dataset)
+    server = make_server(state, args.host, args.port)
+    logger.info("demo serving on http://%s:%d/", args.host, args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

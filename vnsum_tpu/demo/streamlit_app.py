@@ -1,0 +1,97 @@
+"""Streamlit variant of the demo (the reference's streamlit_demo.py:183-287),
+for hosts that have streamlit installed — the stdlib server in
+`vnsum_tpu.demo.server` is the primary frontend on TPU images, which ship
+without streamlit.
+
+    streamlit run vnsum_tpu/demo/streamlit_app.py -- --backend fake
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+try:
+    import streamlit as st
+except ImportError as e:  # pragma: no cover - exercised only sans streamlit
+    raise SystemExit(
+        "streamlit is not installed on this host; use the stdlib demo instead:\n"
+        "  python -m vnsum_tpu.demo.server --backend fake"
+    ) from e
+
+from vnsum_tpu.backend.base import get_backend
+from vnsum_tpu.core.config import APPROACHES
+from vnsum_tpu.data import DocumentDataset
+from vnsum_tpu.demo.core import run_approaches
+
+
+def _args() -> argparse.Namespace:
+    p = argparse.ArgumentParser()
+    p.add_argument("--backend", default="fake",
+                   choices=["tpu", "ollama", "hf", "fake"])
+    p.add_argument("--model", default="llama3.2:3b")
+    p.add_argument("--docs-dir", default="data_1/doc")
+    p.add_argument("--summary-dir", default="data_1/summary")
+    return p.parse_args(sys.argv[1:])
+
+
+@st.cache_resource
+def _backend(spec: str, model: str):
+    if spec == "tpu":
+        from vnsum_tpu.models import MODEL_REGISTRY
+
+        return get_backend("tpu", model_config=MODEL_REGISTRY[model]())
+    if spec == "ollama":
+        return get_backend("ollama", model=model)
+    if spec == "hf":
+        return get_backend("hf", model_name_or_path=model)
+    return get_backend("fake")
+
+
+def main() -> None:
+    args = _args()
+    st.set_page_config(page_title="VN-LongSum TPU demo", layout="wide")
+    st.title("VN-LongSum TPU — so sánh 5 chiến lược tóm tắt")
+
+    text, reference = "", ""
+    if Path(args.docs_dir).is_dir():
+        ds = DocumentDataset(args.docs_dir, args.summary_dir)
+        choice = st.selectbox("Tài liệu mẫu", ["—", *ds.filenames()])
+        if choice != "—":
+            text = ds.read_doc(choice)
+            reference = ds.read_reference(choice) or ""
+    uploaded = st.file_uploader("…hoặc tải lên file .txt", type="txt")
+    if uploaded is not None:
+        text = uploaded.read().decode("utf-8")
+
+    text = st.text_area("Văn bản", value=text, height=240)
+    reference = st.text_area("Tóm tắt tham chiếu (tuỳ chọn)", value=reference,
+                             height=100)
+    chosen = st.multiselect("Chiến lược", list(APPROACHES), default=list(APPROACHES))
+
+    if st.button("Tóm tắt") and text.strip():
+        bar = st.progress(0.0)
+        runs = run_approaches(
+            text,
+            _backend(args.backend, args.model),
+            approaches=chosen,
+            reference=reference.strip() or None,
+            progress=lambda i, n, name: bar.progress(i / n, text=name),
+        )
+        bar.progress(1.0, text="xong")
+        tabs = st.tabs([r.approach for r in runs])
+        for tab, r in zip(tabs, runs):
+            with tab:
+                if r.status == "failed":
+                    st.error(r.error)
+                    continue
+                st.write(r.summary)
+                st.caption(
+                    f"{r.num_chunks} chunks · {r.llm_calls} LLM calls · "
+                    f"{r.seconds:.1f}s"
+                )
+                if r.metrics:
+                    st.table({k: [f"{v:.4f}"] for k, v in r.metrics.items()})
+
+
+main()

@@ -22,7 +22,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-samples", type=int, default=None)
     p.add_argument("--tree-json", default="data_1/document_tree.json")
     p.add_argument("--max-depth", type=int, default=1)
-    p.add_argument("--backend", choices=["tpu", "ollama", "fake"], default="tpu")
+    p.add_argument(
+        "--backend", choices=["tpu", "ollama", "hf", "fake"], default="tpu"
+    )
     p.add_argument("--ollama-url", default="http://localhost:11434")
     p.add_argument("--docs-dir", default="data_1/doc")
     p.add_argument("--summary-dir", default="data_1/summary")

@@ -63,6 +63,12 @@ class PipelineRunner:
             )
         if cfg.backend == "fake":
             return get_backend("fake")
+        if cfg.backend == "hf":
+            return get_backend(
+                "hf", model_name_or_path=model,
+                max_context=cfg.max_context,
+                max_new_tokens=cfg.max_new_tokens,
+            )
         if cfg.backend == "tpu":
             from ..models import MODEL_REGISTRY
 
