@@ -1,0 +1,167 @@
+"""HF checkpoint conversion: logit parity against transformers on CPU.
+
+This is the correctness anchor for real-weight runs (SURVEY.md §7 hard part
+#2: "Llama-3.2-3B weight port + sharding correctness (logit parity vs HF
+CPU)"). A tiny random HF LlamaForCausalLM is converted and both models must
+produce near-identical float32 logits.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from vnsum_tpu.models.convert import (
+    config_from_hf,
+    convert_torch_model,
+    load_hf_checkpoint,
+)
+from vnsum_tpu.models.llama import (
+    forward_train,
+    init_kv_cache,
+    forward,
+    prefill_attention_mask,
+    prefill_positions,
+)
+
+HF_CFG = dict(
+    vocab_size=384,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    max_position_embeddings=256,
+    rope_theta=10000.0,
+    rms_norm_eps=1e-5,
+    tie_word_embeddings=True,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(**HF_CFG)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def converted(hf_model):
+    cfg = config_from_hf(HF_CFG, dtype=jnp.float32)
+    params = convert_torch_model(hf_model, cfg)
+    return cfg, params
+
+
+def _hf_logits(hf_model, tokens: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        out = hf_model(torch.from_numpy(tokens).long())
+    return out.logits.float().numpy()
+
+
+def test_config_from_hf_fields(converted):
+    cfg, _ = converted
+    assert cfg.dim == 64
+    assert cfg.n_layers == 2
+    assert cfg.n_kv_heads == 2
+    assert cfg.head_dim == 16
+    assert cfg.tie_embeddings is True
+    assert cfg.use_llama3_rope_scaling is False
+
+
+def test_config_from_hf_llama3_rope():
+    hf = dict(
+        HF_CFG,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 32.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        },
+    )
+    cfg = config_from_hf(hf)
+    assert cfg.use_llama3_rope_scaling
+    assert cfg.rope_scale_factor == 32.0
+    assert cfg.rope_original_max_len == 8192
+
+
+def test_train_forward_logit_parity(hf_model, converted):
+    cfg, params = converted
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 17), dtype=np.int32)
+    ours = np.asarray(
+        forward_train(params, cfg, jnp.asarray(tokens), remat=False)
+    )
+    ref = _hf_logits(hf_model, tokens)
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_prefill_forward_logit_parity(hf_model, converted):
+    cfg, params = converted
+    rng = np.random.default_rng(1)
+    B, S = 2, 12
+    tokens = rng.integers(0, cfg.vocab_size, size=(B, S), dtype=np.int32)
+    pad = jnp.zeros((B,), jnp.int32)
+    cache = init_kv_cache(cfg, B, S)
+    logits, _ = forward(
+        params, cfg, jnp.asarray(tokens), prefill_positions(pad, S), cache,
+        0, prefill_attention_mask(pad, S, S),
+    )
+    ref = _hf_logits(hf_model, tokens)
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=2e-4, rtol=2e-3)
+
+
+def test_load_hf_checkpoint_safetensors(tmp_path, hf_model, converted):
+    from safetensors.torch import save_file
+
+    cfg, params = converted
+    sd = {k: v.contiguous().clone() for k, v in hf_model.state_dict().items()}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps(HF_CFG))
+
+    cfg2, params2 = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+    assert cfg2.dim == cfg.dim and cfg2.n_layers == cfg.n_layers
+    np.testing.assert_allclose(
+        np.asarray(params2["layers"]["wq"]), np.asarray(params["layers"]["wq"]),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(params2["embed"]), np.asarray(params["embed"]), atol=1e-6
+    )
+
+
+def test_sharded_checkpoint_with_index(tmp_path, hf_model, converted):
+    from safetensors.torch import save_file
+
+    cfg, params = converted
+    sd = {k: v.contiguous().clone() for k, v in hf_model.state_dict().items()}
+    keys = sorted(sd)
+    half = len(keys) // 2
+    shards = {
+        "model-00001-of-00002.safetensors": {k: sd[k] for k in keys[:half]},
+        "model-00002-of-00002.safetensors": {k: sd[k] for k in keys[half:]},
+    }
+    weight_map = {}
+    for shard, tensors in shards.items():
+        save_file(tensors, str(tmp_path / shard))
+        for k in tensors:
+            weight_map[k] = shard
+    (tmp_path / "model.safetensors.index.json").write_text(
+        json.dumps({"weight_map": weight_map})
+    )
+    (tmp_path / "config.json").write_text(json.dumps(HF_CFG))
+
+    _, params2 = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(params2["layers"]["w_down"]),
+        np.asarray(params["layers"]["w_down"]),
+        atol=1e-6,
+    )

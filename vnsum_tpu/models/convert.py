@@ -1,0 +1,171 @@
+"""HF Llama checkpoint -> stacked JAX pytree conversion.
+
+The reference runs its only direct-ML path through HF transformers
+(runners/run_summarization.py:54-62, ``AutoModelForCausalLM.from_pretrained``
+with ``device_map="auto"``). The TPU framework keeps HF format as the
+*interchange* format only: weights are converted once, host-side, into the
+stacked-layer pytree of :mod:`vnsum_tpu.models.llama` and from then on live as
+sharded JAX arrays on the mesh.
+
+Conversion notes:
+- HF ``Linear.weight`` is stored ``[out, in]``; our einsum layouts are
+  ``[in, ...out]``, so every projection is transposed (and reshaped to split
+  the head dims). No RoPE permutation is needed: HF Llama checkpoints already
+  use the rotate-half convention that :func:`..models.llama._apply_rope`
+  implements.
+- Per-layer weights are stacked on a leading ``L`` dim so the decoder runs as
+  one ``lax.scan`` over layers.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from .llama import LlamaConfig
+
+# HF key templates -> (our nested key path, converter)
+_LAYER_KEYS: dict[str, str] = {
+    "self_attn.q_proj.weight": "wq",
+    "self_attn.k_proj.weight": "wk",
+    "self_attn.v_proj.weight": "wv",
+    "self_attn.o_proj.weight": "wo",
+    "mlp.gate_proj.weight": "w_gate",
+    "mlp.up_proj.weight": "w_up",
+    "mlp.down_proj.weight": "w_down",
+    "input_layernorm.weight": "attn_norm",
+    "post_attention_layernorm.weight": "mlp_norm",
+}
+
+
+def config_from_hf(hf: Mapping[str, Any], **overrides) -> LlamaConfig:
+    """Build a :class:`LlamaConfig` from a parsed HF ``config.json`` dict."""
+    rope_scaling = hf.get("rope_scaling") or {}
+    rope_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
+    head_dim = hf.get("head_dim") or (
+        hf["hidden_size"] // hf["num_attention_heads"]
+    )
+    kw: dict[str, Any] = dict(
+        vocab_size=hf["vocab_size"],
+        dim=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        intermediate=hf["intermediate_size"],
+        # defaults below mirror HF LlamaConfig's defaults, since they fill in
+        # for keys absent from config.json
+        rope_theta=hf.get("rope_theta", 10_000.0),
+        norm_eps=hf.get("rms_norm_eps", 1e-6),
+        max_seq_len=hf.get("max_position_embeddings", 16_384),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        use_llama3_rope_scaling=rope_type == "llama3",
+    )
+    if rope_type == "llama3":
+        kw.update(
+            rope_scale_factor=rope_scaling.get("factor", 32.0),
+            rope_low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
+            rope_high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+            rope_original_max_len=rope_scaling.get(
+                "original_max_position_embeddings", 8192
+            ),
+        )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def convert_hf_state_dict(
+    get: Callable[[str], np.ndarray], cfg: LlamaConfig, dtype=None
+) -> dict:
+    """Convert HF-named tensors into the stacked pytree.
+
+    ``get(name)`` returns the tensor for one HF key — a callable so shard
+    files can be memory-mapped and each tensor materialized only once.
+    """
+    import jax.numpy as jnp
+
+    dtype = dtype or cfg.dtype
+    H, KV, hd, D, I = (
+        cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.dim, cfg.intermediate,
+    )
+
+    def conv(name: str, arr: np.ndarray) -> np.ndarray:
+        if name == "wq":
+            return arr.T.reshape(D, H, hd)
+        if name in ("wk", "wv"):
+            return arr.T.reshape(D, KV, hd)
+        if name == "wo":
+            return arr.T.reshape(H, hd, D)
+        if name in ("w_gate", "w_up", "w_down"):
+            return arr.T
+        return arr  # norms, embed
+
+    layers: dict[str, list[np.ndarray]] = {k: [] for k in _LAYER_KEYS.values()}
+    for li in range(cfg.n_layers):
+        for hf_key, ours in _LAYER_KEYS.items():
+            raw = np.asarray(get(f"model.layers.{li}.{hf_key}"))
+            layers[ours].append(conv(ours, raw))
+
+    params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "layers": {
+            k: jnp.asarray(np.stack(v), dtype) for k, v in layers.items()
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(
+            np.asarray(get("lm_head.weight")).T, dtype
+        )
+    return params
+
+
+def _safetensors_getter(model_dir: str) -> Callable[[str], np.ndarray]:
+    """Key -> tensor across one or many ``*.safetensors`` shards."""
+    from safetensors import safe_open
+
+    index_path = os.path.join(model_dir, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            weight_map: dict[str, str] = json.load(f)["weight_map"]
+    else:
+        shards = sorted(
+            f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+        )
+        if not shards:
+            raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+        weight_map = {}
+        for shard in shards:
+            with safe_open(os.path.join(model_dir, shard), framework="np") as f:
+                for key in f.keys():
+                    weight_map[key] = shard
+
+    handles: dict[str, Any] = {}
+
+    def get(name: str) -> np.ndarray:
+        shard = weight_map[name]
+        if shard not in handles:
+            handles[shard] = safe_open(
+                os.path.join(model_dir, shard), framework="np"
+            )
+        return handles[shard].get_tensor(name)
+
+    return get
+
+
+def load_hf_checkpoint(
+    model_dir: str, dtype=None, **config_overrides
+) -> tuple[LlamaConfig, dict]:
+    """Load ``config.json`` + safetensors shards from a local HF model dir."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        cfg = config_from_hf(json.load(f), **config_overrides)
+    params = convert_hf_state_dict(_safetensors_getter(model_dir), cfg, dtype)
+    return cfg, params
+
+
+def convert_torch_model(model, cfg: LlamaConfig, dtype=None) -> dict:
+    """Convert an in-memory HF ``LlamaForCausalLM`` (tests, small models)."""
+    sd = {k: v.detach().cpu().float().numpy() for k, v in model.state_dict().items()}
+    return convert_hf_state_dict(sd.__getitem__, cfg, dtype)
