@@ -61,7 +61,7 @@ def test_empty_intersection_raises(tmp_path):
     ref.mkdir()
     (gen / "x.txt").write_text("a")
     (ref / "y.txt").write_text("b")
-    with pytest.raises(ValueError, match="no matching filenames"):
+    with pytest.raises(ValueError, match="no common filenames"):
         evaluate_summaries(gen, ref, skip_bert=True)
 
 

@@ -33,16 +33,7 @@ class ApproachRun:
     metrics: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
-        return {
-            "approach": self.approach,
-            "summary": self.summary,
-            "num_chunks": self.num_chunks,
-            "llm_calls": self.llm_calls,
-            "seconds": self.seconds,
-            "status": self.status,
-            "error": self.error,
-            "metrics": self.metrics,
-        }
+        return dataclasses.asdict(self)
 
 
 def compute_metrics(summary: str, reference: str) -> dict:

@@ -149,11 +149,19 @@ def make_handler(state: DemoState):
             length = int(self.headers.get("Content-Length", "0"))
             try:
                 req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict) or not isinstance(
+                    req.get("text", ""), str
+                ):
+                    self._json({"error": "malformed request"}, 400)
+                    return
                 text = req.get("text", "")
                 if not text.strip():
                     self._json({"error": "empty document"}, 400)
                     return
-                approaches = req.get("approaches") or None
+                approaches = req.get("approaches")  # None/absent = all
+                if approaches == []:
+                    self._json({"error": "no approaches selected"}, 400)
+                    return
                 if approaches is not None:
                     bad = [a for a in approaches if a not in APPROACHES]
                     if bad:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import threading
 from pathlib import Path
 
 try:
@@ -33,6 +34,13 @@ def _args() -> argparse.Namespace:
     p.add_argument("--docs-dir", default="data_1/doc")
     p.add_argument("--summary-dir", default="data_1/summary")
     return p.parse_args(sys.argv[1:])
+
+
+@st.cache_resource
+def _generate_lock() -> threading.Lock:
+    # backends are not thread-safe (jit caches, stats, torch modules); each
+    # streamlit session runs in its own thread but shares the cached backend
+    return threading.Lock()
 
 
 @st.cache_resource
@@ -71,13 +79,14 @@ def main() -> None:
 
     if st.button("Tóm tắt") and text.strip():
         bar = st.progress(0.0)
-        runs = run_approaches(
-            text,
-            _backend(args.backend, args.model),
-            approaches=chosen,
-            reference=reference.strip() or None,
-            progress=lambda i, n, name: bar.progress(i / n, text=name),
-        )
+        with _generate_lock():
+            runs = run_approaches(
+                text,
+                _backend(args.backend, args.model),
+                approaches=chosen,
+                reference=reference.strip() or None,
+                progress=lambda i, n, name: bar.progress(i / n, text=name),
+            )
         bar.progress(1.0, text="xong")
         tabs = st.tabs([r.approach for r in runs])
         for tab, r in zip(tabs, runs):

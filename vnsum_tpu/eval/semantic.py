@@ -31,6 +31,24 @@ def load_summary_dir(path: str | Path) -> dict[str, str]:
     return out
 
 
+def match_pairs(
+    generated: dict[str, str],
+    references: dict[str, str],
+    max_samples: int | None = None,
+) -> list[str]:
+    """Sorted filenames present on both sides (ref :521-544 intersection);
+    logs what was dropped and raises when nothing matches."""
+    common = sorted(set(generated) & set(references))
+    unpaired = (set(generated) | set(references)) - set(common)
+    if unpaired:
+        logger.info("skipping %d unpaired files", len(unpaired))
+    if max_samples:
+        common = common[:max_samples]
+    if not common:
+        raise ValueError("no common filenames between generated and references")
+    return common
+
+
 class SemanticEvaluator:
     def __init__(
         self,
@@ -51,14 +69,7 @@ class SemanticEvaluator:
         max_samples: int | None = None,
     ) -> dict:
         """Evaluate matching filenames; returns the results-JSON dict."""
-        common = sorted(set(generated) & set(references))
-        unpaired = (set(generated) | set(references)) - set(common)
-        if unpaired:
-            logger.info("skipping %d unpaired files", len(unpaired))
-        if max_samples:
-            common = common[:max_samples]
-        if not common:
-            raise ValueError("no common filenames between generated and references")
+        common = match_pairs(generated, references, max_samples)
 
         gen_texts = [generated[f] for f in common]
         ref_texts = [references[f] for f in common]

@@ -17,7 +17,7 @@ from pathlib import Path
 from ..core.logging import get_logger
 from ..eval.embedding import EmbeddingModel, bert_scores
 from ..eval.rouge import RougeScorer
-from ..eval.semantic import load_summary_dir
+from ..eval.semantic import load_summary_dir, match_pairs
 
 logger = get_logger("vnsum.utils.evaluate")
 
@@ -35,11 +35,7 @@ def evaluate_summaries(
     (ref utils/evaluate_summaries.py:27-106)."""
     generated = load_summary_dir(generated_dir)
     references = load_summary_dir(reference_dir)
-    common = sorted(set(generated) & set(references))
-    if max_samples:
-        common = common[:max_samples]
-    if not common:
-        raise ValueError("no matching filenames between the two folders")
+    common = match_pairs(generated, references, max_samples)
 
     scorer = RougeScorer(["rouge1", "rouge2", "rougeL"], use_stemmer)
     per_file: dict[str, dict] = {}
