@@ -98,3 +98,67 @@ def test_mesh_sharded_generation_matches_single_device():
     np.testing.assert_array_equal(
         plain.generate(prompts), sharded.generate(prompts)
     )
+
+
+def test_early_exit_matches_reference_rollout(engine):
+    """The while_loop decode (early exit on all-EOS) must emit exactly what a
+    token-by-token host rollout of the same greedy policy emits."""
+    import jax
+    import jax.numpy as jnp
+
+    from vnsum_tpu.models import forward, init_kv_cache
+    from vnsum_tpu.models.llama import (
+        decode_attention_mask,
+        prefill_attention_mask,
+        prefill_positions,
+    )
+
+    cfg = engine.cfg
+    tok = engine.tok
+    prompt = "văn bản nguồn để tóm tắt"
+    ids = tok.encode(prompt, add_bos=True)
+    max_new = engine.max_new_tokens
+
+    S = len(ids)
+    C = S + max_new
+    tokens = jnp.asarray([ids], jnp.int32)
+    pad = jnp.zeros((1,), jnp.int32)
+    cache = init_kv_cache(cfg, 1, C)
+    logits, cache = forward(
+        engine.params, cfg, tokens, prefill_positions(pad, S), cache, 0,
+        prefill_attention_mask(pad, S, C), last_only=True,
+    )
+    cur = int(jnp.argmax(logits[0, -1]))
+    emitted = []
+    for t in range(max_new):
+        if cur == tok.eos_id:
+            break
+        emitted.append(cur)
+        mask_t = decode_attention_mask(pad, S + t, C)
+        logits, cache = forward(
+            engine.params, cfg, jnp.asarray([[cur]], jnp.int32),
+            jnp.asarray([[S + t]], jnp.int32) - pad[:, None], cache, S + t,
+            mask_t,
+        )
+        cur = int(jnp.argmax(logits[0, -1]))
+    expected = tok.decode(emitted).strip()
+
+    assert engine.generate([prompt])[0] == expected
+
+
+def test_eos_early_exit_stops_output(engine):
+    """Forcing EOS to the first greedily-chosen token stops decode right
+    after it: the EOS token itself is emitted (scan-era semantics), every
+    later slot stays pad."""
+    prompt = "một đoạn văn"
+    full = engine.generate([prompt])[0]
+    if not full:
+        pytest.skip("greedy output empty for this random model")
+    first_id = engine.tok.encode(full)[0]
+    out = engine.generate(
+        [prompt],
+        max_new_tokens=engine.max_new_tokens,
+        config=GenerationConfig(temperature=0.0, eos_ids=(first_id,)),
+    )[0]
+    assert out == engine.tok.decode([first_id]).strip()
+    assert len(out) < len(full)

@@ -1,0 +1,115 @@
+"""Weight-only int8 quantization: round-trip accuracy, forward fidelity, and
+engine integration (models/quant.py)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vnsum_tpu.backend.engine import TpuBackend
+from vnsum_tpu.core.config import GenerationConfig
+from vnsum_tpu.models.llama import (
+    forward_train,
+    init_params,
+    tiny_llama,
+)
+from vnsum_tpu.models.quant import (
+    dequantize_params,
+    is_quantized,
+    quantize_params,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_llama()
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_round_trip_error_bounded(model):
+    _, params = model
+    qp = quantize_params(params)
+    assert is_quantized(qp)
+    deq = dequantize_params(qp)
+    for name in ("wq", "wo", "w_down"):
+        w = np.asarray(params["layers"][name], np.float32)
+        d = np.asarray(deq["layers"][name])
+        # per-channel int8: error bounded by half a quantization step
+        step = np.abs(w).max() / 127.0
+        assert np.abs(w - d).max() <= step * 0.51
+    # norms pass through untouched
+    np.testing.assert_array_equal(
+        np.asarray(qp["layers"]["attn_norm"]),
+        np.asarray(params["layers"]["attn_norm"]),
+    )
+
+
+def test_quantized_forward_close(model):
+    cfg, params = model
+    qp = quantize_params(params)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16), np.int32)
+    )
+    ref = np.asarray(forward_train(params, cfg, tokens, remat=False))
+    quant = np.asarray(forward_train(qp, cfg, tokens, remat=False))
+    # int8 weight-only should track full precision closely on logits
+    cos = np.sum(ref * quant, -1) / (
+        np.linalg.norm(ref, axis=-1) * np.linalg.norm(quant, axis=-1)
+    )
+    assert cos.min() > 0.999
+    # greedy choice agreement on the vast majority of positions
+    agree = (ref.argmax(-1) == quant.argmax(-1)).mean()
+    assert agree > 0.9
+
+
+def test_untied_lm_head_quantization():
+    cfg = tiny_llama(tie_embeddings=False)
+    params = init_params(jax.random.key(1), cfg)
+    qp = quantize_params(params)
+    assert "lm_head" in qp and is_quantized(qp)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (1, 8), np.int32)
+    )
+    ref = np.asarray(forward_train(params, cfg, tokens, remat=False))
+    quant = np.asarray(forward_train(qp, cfg, tokens, remat=False))
+    assert np.corrcoef(ref.ravel(), quant.ravel())[0, 1] > 0.999
+
+
+def test_engine_quantized_generation(model):
+    cfg, _ = model
+    backend = TpuBackend(
+        model_config=cfg,
+        tokenizer="byte",
+        batch_size=2,
+        max_new_tokens=8,
+        quantize=True,
+        flash=False,
+        generation=GenerationConfig(temperature=0.0),
+    )
+    outs = backend.generate(["Xin chào Việt Nam.", "Quốc hội đã họp."])
+    assert len(outs) == 2
+    assert all(isinstance(o, str) for o in outs)
+    # deterministic across calls (greedy, fixed seed)
+    outs2 = backend.generate(["Xin chào Việt Nam.", "Quốc hội đã họp."])
+    assert outs == outs2
+
+
+def test_engine_quantize_with_mesh_rejected(model):
+    cfg, _ = model
+    from vnsum_tpu.parallel import make_mesh
+
+    try:
+        cpus = jax.devices("cpu")
+    except RuntimeError:
+        cpus = []
+    if len(cpus) < 2:
+        pytest.skip("needs 2 CPU devices")
+    mesh = make_mesh({"data": 2, "model": 1}, platform="cpu")
+    with pytest.raises(NotImplementedError):
+        TpuBackend(
+            model_config=cfg, tokenizer="byte", mesh=mesh, batch_size=2,
+            max_new_tokens=4, quantize=True,
+        )

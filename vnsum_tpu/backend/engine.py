@@ -7,9 +7,10 @@ becomes length-bucketed, fixed-shape [B, S] device batches:
 
 - left-padded prompts so prefill's last row and every decode step share one
   write index across the batch (static shapes, no ragged gather);
-- one jit-compiled prefill + `lax.scan` decode program per (B, S) bucket,
-  cached — bucketing bounds XLA recompiles;
-- greedy or sampled decoding with per-sequence EOS masking inside the scan;
+- one jit-compiled prefill + early-exit `while_loop` decode program per
+  (B, S) bucket, cached — bucketing bounds XLA recompiles, and decode stops
+  as soon as every row has emitted EOS instead of paying the full budget;
+- greedy or sampled decoding with per-sequence EOS masking inside the loop;
 - params and token batches carry NamedShardings over a (data, model) mesh, so
   the same program runs single-chip or TP/DP-sharded with GSPMD collectives.
 """
@@ -84,6 +85,7 @@ class TpuBackend:
         generation: GenerationConfig | None = None,
         seed: int = 0,
         flash: str | bool = "auto",
+        quantize: bool = False,
     ) -> None:
         self.cfg = model_config or llama32_3b()
         # Pallas flash prefill: "auto" enables it on real TPU only (the
@@ -109,6 +111,18 @@ class TpuBackend:
             t0 = time.time()
             params = init_params(jax.random.key(seed), self.cfg)
             logger.info("initialized random params in %.1fs", time.time() - t0)
+        if quantize:
+            if mesh is not None:
+                raise NotImplementedError(
+                    "int8 weights + mesh sharding not wired up yet; "
+                    "quantize=True requires mesh=None"
+                )
+            from ..models.quant import is_quantized, quantize_params
+
+            if not is_quantized(params):
+                t0 = time.time()
+                params = jax.jit(quantize_params)(params)
+                logger.info("int8-quantized params in %.1fs", time.time() - t0)
         if mesh is not None:
             from ..parallel.sharding import shard_params
 
@@ -156,10 +170,22 @@ class TpuBackend:
                 logits[:, -1], sub, gen.temperature, gen.top_k, gen.top_p
             )
 
-            def step(carry, t):
-                cur, cache, done, key = carry
+            # decode loop with early exit: a while_loop instead of a fixed
+            # lax.scan, so the program stops as soon as every row has hit EOS
+            # (real summaries end far before the max_new budget; the scan
+            # would pay for the full budget every time)
+            def emit_token(out, cur, done, t):
                 emit = jnp.where(done, pad_id, cur)
-                done = done | jnp.isin(cur, eos)
+                out = jax.lax.dynamic_update_slice(out, emit[:, None], (0, t))
+                return out, done | jnp.isin(cur, eos)
+
+            def cond(carry):
+                t, _cur, _cache, done, _key, _out = carry
+                return (t < max_new) & ~jnp.all(done)
+
+            def body(carry):
+                t, cur, cache, done, key, out = carry
+                out, done = emit_token(out, cur, done, t)
                 pos = (S - pad_lens) + t
                 mask_t = decode_attention_mask(pad_lens, S + t, C)
                 logits, cache = forward(
@@ -169,13 +195,19 @@ class TpuBackend:
                 nxt = sample_logits(
                     logits[:, -1], sub, gen.temperature, gen.top_k, gen.top_p
                 )
-                return (nxt, cache, done, key), emit
+                return (t + 1, nxt, cache, done, key, out)
 
-            done0 = jnp.zeros((B,), dtype=bool)
-            _, emitted = jax.lax.scan(
-                step, (first, cache, done0, key), jnp.arange(max_new)
+            # each iteration emits BEFORE sampling, so on exit (budget spent
+            # or all rows done) every live slot is already written and the
+            # rest remain pad from the init — identical to a full-length scan
+            out0 = jnp.full((B, max_new), pad_id, dtype=jnp.int32)
+            # all-pad dummy rows (batch bucketing filler) start done, else
+            # their garbage decode would keep the early exit from firing
+            done0 = pad_lens == S
+            *_, out = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), first, cache, done0, key, out0)
             )
-            return emitted.T  # [B, max_new]
+            return out  # [B, max_new]
 
         fn = jax.jit(generate)
         if self.mesh is not None:

@@ -117,6 +117,46 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, cache_len: int) -> dict:
 # -- building blocks --------------------------------------------------------
 
 
+def _proj(sub: str, x: jax.Array, w) -> jax.Array:
+    """Einsum against a weight that may be int8-quantized ({"q", "s"}).
+
+    The int8 values go straight into the matmul (the dtype convert fuses into
+    the MXU tile load, so HBM sees int8); the per-output-channel scale
+    multiplies the result, which is exact because scales never cross the
+    contraction (models/quant.py layout)."""
+    if isinstance(w, dict):
+        y = jnp.einsum(sub, x, w["q"].astype(x.dtype))
+        return (y.astype(jnp.float32) * w["s"]).astype(x.dtype)
+    return jnp.einsum(sub, x, w)
+
+
+def _embed_lookup(embed, tokens: jax.Array, dtype) -> jax.Array:
+    if isinstance(embed, dict):
+        rows = jnp.take(embed["q"], tokens, axis=0).astype(jnp.float32)
+        scales = jnp.take(embed["s"], tokens, axis=0)
+        return (rows * scales[..., None]).astype(dtype)
+    return jnp.take(embed, tokens, axis=0)
+
+
+def _lm_head_logits(x: jax.Array, params: dict, cfg: "LlamaConfig") -> jax.Array:
+    """Final projection in float32 (sampling wants full-precision logits)."""
+    if cfg.tie_embeddings:
+        w = params["embed"]
+        sub = "bsd,vd->bsv"  # tied head contracts the embed row dim
+    else:
+        w = params["lm_head"]
+        sub = "bsd,dv->bsv"
+    if isinstance(w, dict):
+        y = jnp.einsum(
+            sub, x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32
+        )
+        return y * w["s"]
+    if cfg.tie_embeddings:
+        w = w.T
+        sub = "bsd,dv->bsv"
+    return jnp.einsum(sub, x, w, preferred_element_type=jnp.float32)
+
+
 def _rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
@@ -196,9 +236,9 @@ def _block(
     emitting per-layer caches as scan outputs would re-materialize the whole
     ~GB cache every decode step."""
     h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = _proj("bsd,dhk->bshk", h, lp["wq"])
+    k = _proj("bsd,dhk->bshk", h, lp["wk"])
+    v = _proj("bsd,dhk->bshk", h, lp["wv"])
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
 
@@ -215,13 +255,13 @@ def _block(
         attn = _attention(q, k_cache, v_cache, mask, cfg.q_per_kv)
     else:
         attn = attention_fn(q, k_cache, v_cache, mask, cfg.q_per_kv)
-    attn_out = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    attn_out = _proj("bshk,hkd->bsd", attn, lp["wo"])
     x = x + attn_out
 
     h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,di->bsi", h, lp["w_gate"])
-    up = jnp.einsum("bsd,di->bsi", h, lp["w_up"])
-    mlp_out = jnp.einsum("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+    gate = _proj("bsd,di->bsi", h, lp["w_gate"])
+    up = _proj("bsd,di->bsi", h, lp["w_up"])
+    mlp_out = _proj("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"])
     return x + mlp_out, k_all, v_all
 
 
@@ -246,7 +286,7 @@ def forward(
 
     ``attention_fn(q, k_cache, v_cache, mask, q_per_kv)`` overrides the
     dense cache attention (e.g. the Pallas flash kernel for prefill)."""
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _embed_lookup(params["embed"], tokens, cfg.dtype)
     cos, sin = _rope_cos_sin(cfg, positions)
 
     block = _block
@@ -271,10 +311,7 @@ def forward(
     if last_only:
         x = x[:, -1:, :]
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, head, preferred_element_type=jnp.float32
-    )
+    logits = _lm_head_logits(x, params, cfg)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -303,25 +340,23 @@ def forward_train(
     """
     B, S = tokens.shape
     attention_fn = attention_fn or dense_causal_attention
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _embed_lookup(params["embed"], tokens, cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
     cos, sin = _rope_cos_sin(cfg, positions)
 
     def block(x, lp):
         h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = _proj("bsd,dhk->bshk", h, lp["wq"])
+        k = _proj("bsd,dhk->bshk", h, lp["wk"])
+        v = _proj("bsd,dhk->bshk", h, lp["wv"])
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         attn = attention_fn(q, k, v, cfg.q_per_kv)
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        x = x + _proj("bshk,hkd->bsd", attn, lp["wo"])
         h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jnp.einsum("bsd,di->bsi", h, lp["w_gate"])
-        up = jnp.einsum("bsd,di->bsi", h, lp["w_up"])
-        return x + jnp.einsum(
-            "bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"]
-        )
+        gate = _proj("bsd,di->bsi", h, lp["w_gate"])
+        up = _proj("bsd,di->bsi", h, lp["w_up"])
+        return x + _proj("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"])
 
     if remat:
         block = jax.checkpoint(block)
@@ -331,8 +366,7 @@ def forward_train(
 
     x, _ = jax.lax.scan(layer_step, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+    return _lm_head_logits(x, params, cfg)
 
 
 # -- mask / position helpers (host-independent, shape-static) ----------------

@@ -1,0 +1,99 @@
+"""Weight-only int8 quantization for decode throughput.
+
+Single-token decode on a 3B model is HBM-bandwidth-bound: every step streams
+the full weight set. Storing matmul weights as int8 with per-output-channel
+float scales halves that traffic. The matmul runs on the raw int8 values
+(converted to the activation dtype on the way into the MXU — a fusion XLA
+always does) and the scale is applied to the matmul OUTPUT, which is exactly
+equivalent because each scale multiplies only channels that never mix in the
+contraction:
+
+- ``wq/wk/wv [L, D, H, hd]``  (contract d)      -> scale ``[L, H, hd]``
+- ``wo [L, H, hd, D]``        (contract h, k)   -> scale ``[L, D]``
+- ``w_gate/w_up [L, D, I]``   (contract d)      -> scale ``[L, I]``
+- ``w_down [L, I, D]``        (contract i)      -> scale ``[L, D]``
+- ``embed [V, D]``            row-wise          -> scale ``[V]`` (works for
+  both the gather and the tied LM head, whose output channel IS the row)
+- ``lm_head [D, V]``          (contract d)      -> scale ``[V]``
+
+Norm weights stay in full precision (tiny, and numerically sensitive).
+
+The reference has no quantization support at all — its nearest analog is
+running 4-bit Ollama builds like ``gemma3:4b-it-qat``
+(run_full_evaluation_pipeline.py:960-962) as a black box.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# weight name -> axes that are CONTRACTED in its matmul (reduced over for the
+# scale max) ; remaining axes are output channels and keep per-channel scales
+_CONTRACT_AXES = {
+    "wq": (0,), "wk": (0,), "wv": (0,),   # [D, H, hd] contract D
+    "wo": (0, 1),                          # [H, hd, D] contract H, hd
+    "w_gate": (0,), "w_up": (0,),          # [D, I] contract D
+    "w_down": (0,),                        # [I, D] contract I
+}
+
+
+def _quantize(w: jax.Array, contract_axes: tuple[int, ...]) -> dict:
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=contract_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": jnp.squeeze(scale, axis=contract_axes)}
+
+
+def quantize_params(params: dict) -> dict:
+    """Params pytree -> same tree with matmul weights as {"q": int8, "s": f32}.
+
+    Layer weights have a leading stacked L dim, so their contract axes shift
+    by one; the scale keeps the L dim for the layer scan.
+    """
+    layers = {}
+    for name, w in params["layers"].items():
+        if name in _CONTRACT_AXES:
+            axes = tuple(a + 1 for a in _CONTRACT_AXES[name])
+            layers[name] = _quantize(w, axes)
+        else:  # norms
+            layers[name] = w
+
+    out = {
+        "embed": _quantize(params["embed"], (1,)),  # row max -> scale [V]
+        "layers": layers,
+        "final_norm": params["final_norm"],
+    }
+    if "lm_head" in params:
+        out["lm_head"] = _quantize(params["lm_head"], (0,))  # scale [V]
+    return out
+
+
+def dequantize_params(qparams: dict) -> dict:
+    """Inverse transform (tests / round-trip checks)."""
+
+    def deq(leaf, contract_axes):
+        s = leaf["s"]
+        for a in sorted(contract_axes):
+            s = jnp.expand_dims(s, a)
+        return leaf["q"].astype(jnp.float32) * s
+
+    layers = {}
+    for name, w in qparams["layers"].items():
+        if name in _CONTRACT_AXES:
+            axes = tuple(a + 1 for a in _CONTRACT_AXES[name])
+            layers[name] = deq(w, axes)
+        else:
+            layers[name] = w
+    out = {
+        "embed": deq(qparams["embed"], (1,)),
+        "layers": layers,
+        "final_norm": qparams["final_norm"],
+    }
+    if "lm_head" in qparams:
+        out["lm_head"] = deq(qparams["lm_head"], (0,))
+    return out
+
+
+def is_quantized(params: dict) -> bool:
+    return isinstance(params.get("embed"), dict)
