@@ -100,6 +100,31 @@ def test_mesh_sharded_generation_matches_single_device():
     )
 
 
+def test_mesh_sharded_quantized_generation_matches_single_device():
+    """int8 weights + TP/DP mesh: scales shard with their output channels, so
+    sharded quantized decode must match unsharded quantized decode exactly."""
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.parallel import make_mesh
+
+    cfg = tiny_llama(max_seq_len=128)
+    plain = TpuBackend(
+        model_config=cfg, batch_size=4, max_new_tokens=6, seed=3, quantize=True
+    )
+    mesh = make_mesh({"data": 2, "model": 2, "seq": 1}, platform="cpu")
+    sharded = TpuBackend(
+        model_config=cfg,
+        batch_size=4,
+        max_new_tokens=6,
+        mesh=mesh,
+        seed=3,
+        quantize=True,
+    )
+    prompts = ["văn bản một", "văn bản thứ hai dài hơn", "ba", "bốn bốn bốn"]
+    np.testing.assert_array_equal(
+        plain.generate(prompts), sharded.generate(prompts)
+    )
+
+
 def test_early_exit_matches_reference_rollout(engine):
     """The while_loop decode (early exit on all-EOS) must emit exactly what a
     token-by-token host rollout of the same greedy policy emits."""

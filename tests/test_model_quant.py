@@ -97,19 +97,30 @@ def test_engine_quantized_generation(model):
     assert outs == outs2
 
 
-def test_engine_quantize_with_mesh_rejected(model):
-    cfg, _ = model
-    from vnsum_tpu.parallel import make_mesh
+def test_quantized_param_specs_match_tree():
+    """The quantized PartitionSpec tree must be structurally identical to a
+    quantized param tree, with each scale spec = weight spec minus the
+    contracted axes (so scales shard with their output channels)."""
+    from jax.sharding import PartitionSpec as P
 
-    try:
-        cpus = jax.devices("cpu")
-    except RuntimeError:
-        cpus = []
-    if len(cpus) < 2:
-        pytest.skip("needs 2 CPU devices")
-    mesh = make_mesh({"data": 2, "model": 1}, platform="cpu")
-    with pytest.raises(NotImplementedError):
-        TpuBackend(
-            model_config=cfg, tokenizer="byte", mesh=mesh, batch_size=2,
-            max_new_tokens=4, quantize=True,
-        )
+    from vnsum_tpu.models import init_params
+    from vnsum_tpu.models.llama import LlamaConfig
+    from vnsum_tpu.models.quant import quantize_params
+    from vnsum_tpu.parallel.sharding import param_specs
+
+    cfg = LlamaConfig(
+        vocab_size=64, dim=16, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=4, intermediate=32, max_seq_len=32,
+        use_llama3_rope_scaling=False, tie_embeddings=False,
+    )
+    qparams = quantize_params(init_params(jax.random.key(0), cfg))
+    specs = param_specs(tie_embeddings=False, quantized=True)
+    # same tree structure, and every spec rank matches its leaf rank
+    flat_p = jax.tree.structure(qparams)
+    flat_s = jax.tree.structure(specs, is_leaf=lambda x: isinstance(x, P))
+    assert flat_p == flat_s
+    for leaf, spec in zip(
+        jax.tree.leaves(qparams),
+        jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
+    ):
+        assert leaf.ndim == len(spec), (leaf.shape, spec)

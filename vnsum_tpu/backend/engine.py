@@ -112,11 +112,6 @@ class TpuBackend:
             params = init_params(jax.random.key(seed), self.cfg)
             logger.info("initialized random params in %.1fs", time.time() - t0)
         if quantize:
-            if mesh is not None:
-                raise NotImplementedError(
-                    "int8 weights + mesh sharding not wired up yet; "
-                    "quantize=True requires mesh=None"
-                )
             from ..models.quant import is_quantized, quantize_params
 
             if not is_quantized(params):
@@ -213,13 +208,16 @@ class TpuBackend:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from ..models.quant import is_quantized
             from ..parallel.sharding import param_shardings
 
             ns = lambda spec: NamedSharding(self.mesh, spec)
             fn = jax.jit(
                 generate,
                 in_shardings=(
-                    param_shardings(self.mesh, cfg.tie_embeddings),
+                    param_shardings(
+                        self.mesh, cfg.tie_embeddings, is_quantized(self.params)
+                    ),
                     ns(P("data", None)),
                     ns(P("data")),
                     None,

@@ -151,9 +151,6 @@ def _lm_head_logits(x: jax.Array, params: dict, cfg: "LlamaConfig") -> jax.Array
             sub, x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32
         )
         return y * w["s"]
-    if cfg.tie_embeddings:
-        w = w.T
-        sub = "bsd,dv->bsv"
     return jnp.einsum(sub, x, w, preferred_element_type=jnp.float32)
 
 

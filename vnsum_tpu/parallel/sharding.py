@@ -18,11 +18,19 @@ from .mesh import AXES
 _D, _M = AXES.data, AXES.model
 
 
-def param_specs(tie_embeddings: bool = True) -> dict[str, Any]:
+def param_specs(
+    tie_embeddings: bool = True, quantized: bool = False
+) -> dict[str, Any]:
     """PartitionSpec pytree matching models.llama param structure.
 
     Layer leaves carry a leading stacked-layer dim (scanned), hence the
     leading None in every layer spec.
+
+    With ``quantized=True`` the tree matches models.quant.quantize_params
+    output: each matmul weight becomes ``{"q": <weight spec>, "s": <scale
+    spec>}`` where the scale spec is the weight spec with the contracted
+    axes removed (a per-output-channel scale lives on the output axes, so it
+    inherits exactly their sharding).
     """
     specs = {
         "embed": P(_M, None),          # vocab-sharded embedding
@@ -41,6 +49,19 @@ def param_specs(tie_embeddings: bool = True) -> dict[str, Any]:
     }
     if not tie_embeddings:
         specs["lm_head"] = P(None, _M)       # [D, V]
+    if quantized:
+        from ..models.quant import _CONTRACT_AXES
+
+        def qspec(spec: P, contract_axes: tuple[int, ...]) -> dict:
+            scale = P(*(ax for i, ax in enumerate(spec) if i not in contract_axes))
+            return {"q": spec, "s": scale}
+
+        for name, axes in _CONTRACT_AXES.items():
+            shifted = tuple(a + 1 for a in axes)  # leading stacked-L dim
+            specs["layers"][name] = qspec(specs["layers"][name], shifted)
+        specs["embed"] = qspec(specs["embed"], (1,))
+        if not tie_embeddings:
+            specs["lm_head"] = qspec(specs["lm_head"], (0,))
     return specs
 
 
@@ -54,15 +75,39 @@ def batch_spec() -> P:
     return P(_D, None)
 
 
-def param_shardings(mesh: Mesh, tie_embeddings: bool = True) -> dict[str, Any]:
+def param_shardings(
+    mesh: Mesh, tie_embeddings: bool = True, quantized: bool = False
+) -> dict[str, Any]:
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(tie_embeddings),
+        param_specs(tie_embeddings, quantized),
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
 def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True) -> Any:
-    """Place a param pytree onto the mesh with TP shardings."""
-    shardings = param_shardings(mesh, tie_embeddings)
+    """Place a param pytree onto the mesh with TP shardings.
+
+    Raises a config-level error (which sharded dim, which axis) instead of
+    letting device_put surface a raw XLA divisibility failure.
+    """
+    from ..models.quant import is_quantized
+
+    quantized = is_quantized(params)
+    specs = param_specs(tie_embeddings, quantized)
+
+    def check(leaf, spec):
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            size = mesh.shape.get(axis, 1)
+            if leaf.shape[dim] % size:
+                raise ValueError(
+                    f"param dim {dim} (size {leaf.shape[dim]}) is not "
+                    f"divisible by mesh axis '{axis}' ({size}); shrink that "
+                    "mesh axis or pick a TP-compatible model config"
+                )
+
+    jax.tree.map(check, params, specs, is_leaf=lambda x: isinstance(x, P))
+    shardings = param_shardings(mesh, tie_embeddings, quantized)
     return jax.tree.map(jax.device_put, params, shardings)

@@ -47,7 +47,9 @@ class TrainCheckpointer:
         )
         if wait:
             self.manager.wait_until_finished()
-        logger.info("saved checkpoint step=%d at %s", step, self.directory)
+            logger.info("saved checkpoint step=%d at %s", step, self.directory)
+        else:
+            logger.info("queued checkpoint step=%d at %s", step, self.directory)
         return step
 
     def restore(self, trainer, step: int | None = None) -> int:
