@@ -10,11 +10,11 @@ from vnsum_tpu.ops.flash_attention import flash_prefill_attention, supports_flas
 def make_qkv(B, S, C, H, KV, hd, seed=0):
     kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
     q = jax.random.normal(kq, (B, S, H, hd), jnp.float32)
-    k = jnp.zeros((B, C, KV, hd), jnp.float32)
-    v = jnp.zeros((B, C, KV, hd), jnp.float32)
+    k = jnp.zeros((B, KV, C, hd), jnp.float32)  # cache-native layout
+    v = jnp.zeros((B, KV, C, hd), jnp.float32)
     # fill only the prefill region like the engine does
-    k = k.at[:, :S].set(jax.random.normal(kk, (B, S, KV, hd), jnp.float32))
-    v = v.at[:, :S].set(jax.random.normal(kv, (B, S, KV, hd), jnp.float32))
+    k = k.at[:, :, :S].set(jax.random.normal(kk, (B, KV, S, hd), jnp.float32))
+    v = v.at[:, :, :S].set(jax.random.normal(kv, (B, KV, S, hd), jnp.float32))
     return q, k, v
 
 

@@ -92,6 +92,12 @@ class TpuBackend:
         # kernel needs Mosaic; CPU tests use interpret mode explicitly)
         if flash == "auto":
             flash = jax.default_backend() == "tpu" and mesh is None
+        elif flash and mesh is not None:
+            raise ValueError(
+                "flash=True is incompatible with a mesh: the Pallas kernels "
+                "run per-chip (no shard_map wiring); under GSPMD they would "
+                "force an all-gather of the stacked KV cache every step"
+            )
         self.flash = bool(flash)
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
         self.mesh = mesh
@@ -137,13 +143,32 @@ class TpuBackend:
         pad_id = self.tok.pad_id
 
         use_flash = self.flash
+        use_flash_decode = False
         if use_flash:
+            from ..ops.decode_attention import supports_decode
             from ..ops.flash_attention import supports_flash
 
             use_flash = supports_flash(S, C, cfg.head_dim)
+            use_flash_decode = supports_decode(C, cfg.head_dim)
+
+        mesh = self.mesh
 
         def generate(params, tokens, pad_lens, seed):
             cache = init_kv_cache(cfg, B, C)
+            if mesh is not None:
+                # pin the cache layout (batch over data, heads over model)
+                # instead of leaving it to GSPMD propagation
+                from jax.sharding import NamedSharding
+
+                from ..parallel.sharding import cache_specs
+
+                cache = jax.lax.with_sharding_constraint(
+                    cache,
+                    jax.tree.map(
+                        lambda s: NamedSharding(mesh, s), cache_specs(),
+                        is_leaf=lambda x: not isinstance(x, dict),
+                    ),
+                )
             positions = prefill_positions(pad_lens, S)
             mask = prefill_attention_mask(pad_lens, S, C)
             attention_fn = None
@@ -183,8 +208,19 @@ class TpuBackend:
                 out, done = emit_token(out, cur, done, t)
                 pos = (S - pad_lens) + t
                 mask_t = decode_attention_mask(pad_lens, S + t, C)
+                stacked_fn = None
+                if use_flash_decode:
+                    from ..ops.decode_attention import flash_decode_attention
+
+                    def stacked_fn(q, k_all, v_all, layer_idx):
+                        return flash_decode_attention(
+                            q, k_all, v_all, layer_idx, pad_lens, S + t,
+                            cfg.q_per_kv,
+                        )
+
                 logits, cache = forward(
-                    params, cfg, cur[:, None], pos[:, None], cache, S + t, mask_t
+                    params, cfg, cur[:, None], pos[:, None], cache, S + t,
+                    mask_t, stacked_attention_fn=stacked_fn,
                 )
                 key, sub = jax.random.split(key)
                 nxt = sample_logits(

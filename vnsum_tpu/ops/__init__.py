@@ -1,3 +1,9 @@
+from .decode_attention import flash_decode_attention, supports_decode
 from .flash_attention import flash_prefill_attention, supports_flash
 
-__all__ = ["flash_prefill_attention", "supports_flash"]
+__all__ = [
+    "flash_decode_attention",
+    "flash_prefill_attention",
+    "supports_decode",
+    "supports_flash",
+]

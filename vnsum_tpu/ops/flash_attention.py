@@ -119,8 +119,8 @@ def supports_flash(seq_len: int, cache_len: int, head_dim: int) -> bool:
 )
 def flash_prefill_attention(
     q: jax.Array,         # [B, S, H, hd]
-    k: jax.Array,         # [B, C, KV, hd]
-    v: jax.Array,         # [B, C, KV, hd]
+    k: jax.Array,         # [B, KV, C, hd] — cache-native layout, no transpose
+    v: jax.Array,         # [B, KV, C, hd]
     pad_lens: jax.Array,  # [B] int32 — left-pad per sequence
     q_per_kv: int,
     *,
@@ -131,15 +131,15 @@ def flash_prefill_attention(
     """Returns [B, S, H, hd]; semantics match _attention with the prefill
     mask (pad_b <= j <= i over cache slots)."""
     B, S, H, hd = q.shape
-    C = k.shape[1]
+    C = k.shape[2]
     bq = _pick_block(S, block_q)
     bk = _pick_block(C, block_k)
     if bq is None or bk is None or hd % _LANES:
         raise ValueError(f"unsupported flash shapes S={S} C={C} hd={hd}")
 
     qt = q.transpose(0, 2, 1, 3)   # [B, H, S, hd]
-    kt = k.transpose(0, 2, 1, 3)   # [B, KV, C, hd]
-    vt = v.transpose(0, 2, 1, 3)
+    kt = k
+    vt = v
 
     grid = (B, H, S // bq, C // bk)
     kernel = functools.partial(

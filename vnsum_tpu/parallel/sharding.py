@@ -66,8 +66,8 @@ def param_specs(
 
 
 def cache_specs() -> dict[str, Any]:
-    """KV cache [L, B, C, kv_heads, hd]: batch over data, heads over model."""
-    return {"k": P(None, _D, None, _M, None), "v": P(None, _D, None, _M, None)}
+    """KV cache [L, B, kv_heads, C, hd]: batch over data, heads over model."""
+    return {"k": P(None, _D, _M, None, None), "v": P(None, _D, _M, None, None)}
 
 
 def batch_spec() -> P:
