@@ -40,8 +40,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG = -1e30
-_LANES = 128
+from .flash_attention import _LANES, _NEG, _pick_block
 
 
 def _kernel(
@@ -125,13 +124,6 @@ def _kernel(
                 o_ref[0, i, h] = (
                     acc_ref[i, g0 : g0 + G] / l
                 ).astype(o_ref.dtype)
-
-
-def _pick_block(n: int, preferred: int) -> int | None:
-    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
-        if b <= preferred and n % b == 0:
-            return b
-    return None
 
 
 def _pick_block_b(batch: int) -> int:

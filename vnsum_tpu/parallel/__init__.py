@@ -1,10 +1,22 @@
+from .distributed import (
+    barrier,
+    init_distributed,
+    is_primary,
+    make_hybrid_mesh,
+    process_count,
+)
 from .mesh import MeshAxes, make_mesh, mesh_from_spec
 from .sharding import batch_spec, param_shardings, param_specs, shard_params
 
 __all__ = [
     "MeshAxes",
+    "barrier",
+    "init_distributed",
+    "is_primary",
+    "make_hybrid_mesh",
     "make_mesh",
     "mesh_from_spec",
+    "process_count",
     "batch_spec",
     "param_shardings",
     "param_specs",

@@ -1,0 +1,167 @@
+"""Multi-host (multi-process) runtime: jax.distributed + hybrid ICI/DCN mesh.
+
+The reference's only cross-process channel is HTTP to a local Ollama server
+(SURVEY.md §2.2 "Distributed comm backend: None"). The TPU-native equivalent
+is the single-controller JAX model: every host runs this same program,
+`jax.distributed.initialize` wires the cluster, and GSPMD inserts the
+collectives — over ICI within a slice, over DCN between slices. Nothing here
+issues an RPC by hand.
+
+Axis placement follows the scaling-book recipe: put *data* parallelism on
+DCN (gradient/batch all-reduces amortize over a whole step) and keep
+*model*/*seq* axes inside a slice on ICI (their collectives sit on the
+critical path of every matmul).
+
+Typical multi-host entry:
+
+    from vnsum_tpu.parallel import init_distributed, make_hybrid_mesh
+    init_distributed()                       # env-driven (JAX_COORDINATOR...)
+    mesh = make_hybrid_mesh(ici={"model": 4, "data": 2}, dcn={"data": 4})
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXES, make_mesh
+
+_INITIALIZED = False
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+) -> bool:
+    """Initialize the JAX distributed runtime for multi-host execution.
+
+    Arguments fall back to the standard environment (JAX_COORDINATOR_ADDRESS
+    / JAX_NUM_PROCESSES / JAX_PROCESS_ID, or the cloud-TPU metadata that
+    jax.distributed auto-detects). Returns True if the runtime was (or had
+    already been) initialized, False when running single-process with no
+    cluster configuration — callers can treat False as "local mode".
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    explicit = coordinator_address is not None or num_processes not in (None, 1)
+    if not explicit and not _cluster_env_detected():
+        return False  # single-process dev box: nothing to wire
+    try:
+        # with no explicit args this uses jax.distributed's own auto-detect
+        # (cloud-TPU metadata, Slurm, Open MPI)
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except RuntimeError as e:
+        if "only be called once" in str(e):
+            # a launch script initialized the runtime before us; that
+            # satisfies this call's contract
+            pass
+        elif not explicit:
+            # auto-detect is best-effort: a cluster-looking env where the
+            # backend is already up (or metadata is absent) degrades to
+            # local mode instead of crashing single-host runs
+            from ..core.logging import get_logger
+
+            get_logger("vnsum.distributed").warning(
+                "distributed auto-init failed, continuing single-process: %s", e
+            )
+            return False
+        else:
+            raise
+    _INITIALIZED = True
+    return True
+
+
+def _cluster_env_detected() -> bool:
+    """Heuristic for managed multi-host launchers whose auto-detect
+    jax.distributed.initialize understands. Checked via env only — probing
+    jax.devices() here would initialize the local backend and break a later
+    distributed init."""
+    markers = (
+        "TPU_WORKER_HOSTNAMES",   # cloud TPU pod slice
+        "MEGASCALE_COORDINATOR_ADDRESS",  # multislice
+        "SLURM_JOB_NUM_NODES",
+        "OMPI_COMM_WORLD_SIZE",
+    )
+    if os.environ.get("SLURM_JOB_NUM_NODES", "1") != "1":
+        return True
+    if os.environ.get("OMPI_COMM_WORLD_SIZE", "1") != "1":
+        return True
+    return any(os.environ.get(m) for m in markers[:2])
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """True on process 0 — gate log files, checkpoint writes, report emission."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "vnsum") -> None:
+    """Block until every process reaches this point (no-op single-process)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def make_hybrid_mesh(
+    ici: dict[str, int] | None = None,
+    dcn: dict[str, int] | None = None,
+    *,
+    platform: str | None = None,
+) -> Mesh:
+    """Mesh spanning multiple slices: per-axis ICI sizes within a slice and
+    DCN sizes across slices. Falls back to a plain single-slice mesh when
+    every DCN size is 1 (so single-host code can call this unconditionally).
+
+    The resulting axis size is ici[axis] * dcn[axis]; device order within an
+    axis puts the DCN dimension major, so shardings that keep `model`/`seq`
+    DCN-free never send matmul collectives over the slow network.
+    """
+    ici = dict(ici or {})
+    dcn = dict(dcn or {})
+    names = (AXES.data, AXES.model, AXES.seq)
+    unknown = (set(ici) | set(dcn)) - set(names)
+    if unknown:
+        raise ValueError(f"unknown mesh axes: {sorted(unknown)}")
+    for ax in names:
+        ici.setdefault(ax, 1)
+        dcn.setdefault(ax, 1)
+
+    if int(np.prod(list(dcn.values()))) == 1:
+        return make_mesh(ici, platform=platform)
+
+    from jax.experimental import mesh_utils
+
+    n_slices = int(np.prod(list(dcn.values())))
+    if jax.process_count() < n_slices:
+        raise ValueError(
+            f"hybrid mesh wants {n_slices} slices over DCN but only "
+            f"{jax.process_count()} process(es) are attached — run under "
+            "init_distributed() on a multi-slice deployment"
+        )
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=[ici[ax] for ax in names],
+        dcn_mesh_shape=[dcn[ax] for ax in names],
+        devices=jax.devices(platform) if platform else jax.devices(),
+    )
+    return Mesh(devices, names)
