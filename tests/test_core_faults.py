@@ -1,0 +1,135 @@
+"""Fault injection + retry policy: containment proofs the reference never
+had (SURVEY.md §5 — no retries anywhere, failure handling = per-model
+try/except)."""
+import pytest
+
+from vnsum_tpu.backend.fake import FakeBackend
+from vnsum_tpu.core.faults import (
+    FaultInjectingBackend,
+    FaultPlan,
+    FaultRule,
+    RetryingBackend,
+    call_with_retries,
+)
+
+
+def flaky(plan_rules, **kw):
+    return FaultInjectingBackend(FakeBackend(**kw), FaultPlan(rules=plan_rules))
+
+
+def test_fault_on_call_index():
+    be = flaky([FaultRule(on_call=2)])
+    assert be.generate(["<content>a b c</content>"])  # call 1 fine
+    with pytest.raises(RuntimeError, match="injected fault"):
+        be.generate(["x"])
+    assert be.generate(["y"])  # call 3 fine again
+
+
+def test_fault_every_n_and_corruption():
+    be = flaky([FaultRule(kind="corrupt", every_n=2, corruption="hỏng")])
+    ok = be.generate(["<content>một hai</content>"])
+    bad = be.generate(["<content>một hai</content>"])
+    assert ok == ["một hai"] and bad == ["hỏng"]
+
+
+def test_fault_probability_deterministic():
+    plan = FaultPlan(rules=[FaultRule(probability=0.5)], seed=7)
+    fired = []
+    for i in range(20):
+        rule = plan.check()
+        fired.append(rule is not None)
+    plan2 = FaultPlan(rules=[FaultRule(probability=0.5)], seed=7)
+    fired2 = [plan2.check() is not None for _ in range(20)]
+    assert fired == fired2 and any(fired) and not all(fired)
+
+
+def test_retrying_backend_recovers(monkeypatch):
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    be = RetryingBackend(flaky([FaultRule(on_call=1)]), max_retries=1, backoff=0)
+    assert be.generate(["<content>a b</content>"]) == ["a b"]
+
+
+def test_retrying_backend_gives_up(monkeypatch):
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    be = RetryingBackend(
+        flaky([FaultRule(every_n=1)]), max_retries=2, backoff=0
+    )
+    with pytest.raises(RuntimeError):
+        be.generate(["x"])
+    assert be.plan.calls == 3  # 1 try + 2 retries, all injected
+
+
+def test_call_with_retries_passthrough():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return "ok"
+
+    assert call_with_retries(fn, max_retries=3) == "ok"
+    assert len(calls) == 1
+
+
+@pytest.fixture()
+def workspace(tmp_path):
+    docs = tmp_path / "doc"
+    refs = tmp_path / "summary"
+    docs.mkdir(), refs.mkdir()
+    for i in range(3):
+        (docs / f"d{i}.txt").write_text(
+            "Quốc hội đã thông qua nghị quyết quan trọng. " * 30,
+            encoding="utf-8",
+        )
+        (refs / f"d{i}.txt").write_text("Tóm tắt.", encoding="utf-8")
+    return tmp_path
+
+
+def faulty_pipeline(ws, rules, **cfg_kw):
+    from vnsum_tpu.core.config import PipelineConfig
+    from vnsum_tpu.eval import EmbeddingModel
+    from vnsum_tpu.models.encoder import tiny_encoder
+    from vnsum_tpu.pipeline.runner import PipelineRunner
+
+    cfg = PipelineConfig(
+        approach="mapreduce", backend="fake", models=["m"],
+        docs_dir=str(ws / "doc"), summary_dir=str(ws / "summary"),
+        generated_summaries_dir=str(ws / "gen"),
+        results_dir=str(ws / "res"), logs_dir=str(ws / "logs"),
+        chunk_size=80, chunk_overlap=5, token_max=200, batch_size=3,
+        retry_backoff=0, **cfg_kw,
+    )
+    factory = lambda model: FaultInjectingBackend(
+        FakeBackend(), FaultPlan(rules=rules)
+    )
+    return PipelineRunner(
+        cfg,
+        backend_factory=factory,
+        embedding_model=EmbeddingModel(
+            config=tiny_encoder(), max_len=64, batch_size=4
+        ),
+    )
+
+
+def test_pipeline_batch_retry_recovers(workspace, monkeypatch):
+    """A transient engine fault on one batch must be retried and the run
+    must complete with every document successful."""
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    runner = faulty_pipeline(
+        workspace, [FaultRule(on_call=1)], max_batch_retries=1
+    )
+    results = runner.run()
+    rec = results.summarization["m"]
+    assert rec["successful"] == 3 and rec["failed"] == 0
+
+
+def test_pipeline_persistent_fault_contained(workspace, monkeypatch):
+    """A persistent fault exhausts retries: the batch's docs are recorded
+    failed, and the run still completes with a results record."""
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    runner = faulty_pipeline(
+        workspace, [FaultRule(every_n=1)], max_batch_retries=1
+    )
+    results = runner.run()
+    rec = results.summarization["m"]
+    assert rec["failed"] == 3 and rec["successful"] == 0
+    assert all(d["status"] == "failed" for d in rec["processing_details"])

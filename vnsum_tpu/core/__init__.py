@@ -5,6 +5,13 @@ from .config import (
     PipelineConfig,
     approach_defaults,
 )
+from .faults import (
+    FaultInjectingBackend,
+    FaultPlan,
+    FaultRule,
+    RetryingBackend,
+    call_with_retries,
+)
 from .logging import get_logger, setup_run_logging
 from .profiling import Tracer, annotate, device_profile
 from .results import DocumentRecord, ModelRunRecord, PipelineResults
@@ -18,6 +25,11 @@ __all__ = [
     "GenerationConfig",
     "PipelineConfig",
     "approach_defaults",
+    "FaultInjectingBackend",
+    "FaultPlan",
+    "FaultRule",
+    "RetryingBackend",
+    "call_with_retries",
     "get_logger",
     "setup_run_logging",
     "DocumentRecord",

@@ -96,6 +96,12 @@ class PipelineConfig:
     max_depth: int = 1
     tree_json_path: str = "data_1/document_tree.json"
 
+    # failure containment: re-submit a failed document batch this many extra
+    # times before recording its documents as failed (reference: none —
+    # SURVEY.md §5 "No retries anywhere")
+    max_batch_retries: int = 1
+    retry_backoff: float = 1.0
+
     # engine
     batch_size: int = 8
     tokenizer: str = "byte"  # byte | hf:<name-or-path>

@@ -1,0 +1,154 @@
+"""Fault injection + retry policy for the backend seam.
+
+The reference has no fault injection and no retries anywhere (SURVEY.md §5
+"Failure detection": try/except per model, nothing else). A batched TPU
+engine concentrates risk — one failed device batch takes a whole group of
+documents with it — so the framework provides:
+
+- `FaultPlan` / `FaultInjectingBackend`: a deterministic chaos wrapper for
+  any Backend, used by the test suite to prove containment (and available
+  under `--backend fake+faults`-style manual runs). Faults are by call
+  index, every-N, or seeded probability; they raise or corrupt output.
+- `RetryingBackend`: generic retry-with-exponential-backoff around any
+  backend's `generate` (the Ollama backend additionally retries per-HTTP
+  request below this seam).
+- `call_with_retries`: host-side helper the pipeline uses to re-submit a
+  failed document batch before recording its documents as failed.
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+
+from .logging import get_logger
+
+logger = get_logger("vnsum.faults")
+
+
+@dataclass
+class FaultRule:
+    """One injection rule, matched against the 1-based generate() call index.
+
+    kind: "raise" (throw `error`) or "corrupt" (replace outputs with
+    `corruption`). Exactly one of `on_call`, `every_n`, `probability` selects
+    when the rule fires.
+    """
+
+    kind: str = "raise"
+    on_call: int | None = None
+    every_n: int | None = None
+    probability: float | None = None
+    error: Exception | None = None
+    corruption: str = ""
+
+    def fires(self, call_index: int, rng: random.Random) -> bool:
+        if self.on_call is not None:
+            return call_index == self.on_call
+        if self.every_n is not None:
+            return call_index % self.every_n == 0
+        if self.probability is not None:
+            return rng.random() < self.probability
+        return False
+
+
+@dataclass
+class FaultPlan:
+    rules: list[FaultRule] = field(default_factory=list)
+    seed: int = 0
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+        self._calls = 0
+
+    def check(self) -> FaultRule | None:
+        """Advance the call counter; return the first firing rule, if any."""
+        self._calls += 1
+        for rule in self.rules:
+            if rule.fires(self._calls, self._rng):
+                return rule
+        return None
+
+    @property
+    def calls(self) -> int:
+        return self._calls
+
+
+class FaultInjectingBackend:
+    """Wrap a Backend; inject faults per the plan on each generate() call."""
+
+    def __init__(self, inner, plan: FaultPlan) -> None:
+        self.inner = inner
+        self.plan = plan
+        self.name = f"{inner.name}+faults"
+
+    def generate(self, prompts, **kw):
+        rule = self.plan.check()
+        if rule is not None:
+            if rule.kind == "raise":
+                err = rule.error or RuntimeError(
+                    f"injected fault on call {self.plan.calls}"
+                )
+                logger.warning("injecting %r on call %d", err, self.plan.calls)
+                raise err
+            if rule.kind == "corrupt":
+                logger.warning("corrupting output of call %d", self.plan.calls)
+                return [rule.corruption for _ in prompts]
+            raise ValueError(f"unknown fault kind {rule.kind!r}")
+        return self.inner.generate(prompts, **kw)
+
+    def count_tokens(self, text: str) -> int:
+        return self.inner.count_tokens(text)
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+def call_with_retries(
+    fn,
+    *,
+    max_retries: int,
+    backoff: float = 1.0,
+    retryable: tuple[type[BaseException], ...] = (Exception,),
+    what: str = "call",
+):
+    """Run fn(); on a retryable failure wait backoff * 2^attempt and rerun,
+    up to max_retries extra attempts (negative clamps to 0 — fn always runs
+    at least once). Re-raises the last failure."""
+    max_retries = max(max_retries, 0)
+    for attempt in range(max_retries + 1):
+        try:
+            return fn()
+        except retryable as e:
+            if attempt >= max_retries:
+                raise
+            delay = backoff * (2 ** attempt)
+            logger.warning(
+                "%s failed (%s: %s); retry %d/%d in %.1fs",
+                what, type(e).__name__, e, attempt + 1, max_retries, delay,
+            )
+            time.sleep(delay)
+
+
+class RetryingBackend:
+    """Generic retry wrapper for any Backend's generate()."""
+
+    def __init__(self, inner, max_retries: int = 2, backoff: float = 1.0) -> None:
+        self.inner = inner
+        self.max_retries = max_retries
+        self.backoff = backoff
+        self.name = f"{inner.name}+retry"
+
+    def generate(self, prompts, **kw):
+        return call_with_retries(
+            lambda: self.inner.generate(prompts, **kw),
+            max_retries=self.max_retries,
+            backoff=self.backoff,
+            what=f"{self.inner.name}.generate({len(prompts)} prompts)",
+        )
+
+    def count_tokens(self, text: str) -> int:
+        return self.inner.count_tokens(text)
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)

@@ -67,15 +67,18 @@ def init_distributed(
             process_id=process_id,
             local_device_ids=local_device_ids,
         )
-    except RuntimeError as e:
+    except (RuntimeError, ValueError) as e:
+        # jax raises RuntimeError when the backend is already up (or on a
+        # second initialize) and ValueError when auto-detect finds no
+        # coordinator metadata
         if "only be called once" in str(e):
             # a launch script initialized the runtime before us; that
             # satisfies this call's contract
             pass
         elif not explicit:
-            # auto-detect is best-effort: a cluster-looking env where the
-            # backend is already up (or metadata is absent) degrades to
-            # local mode instead of crashing single-host runs
+            # auto-detect is best-effort: a cluster-looking env with no
+            # usable metadata (or an already-up backend) degrades to local
+            # mode instead of crashing single-host runs
             from ..core.logging import get_logger
 
             get_logger("vnsum.distributed").warning(

@@ -17,6 +17,7 @@ from pathlib import Path
 
 from ..backend.base import Backend, get_backend
 from ..core.config import PipelineConfig
+from ..core.faults import call_with_retries
 from ..core.logging import get_logger, setup_run_logging
 from ..core.profiling import Tracer, device_profile
 from ..core.results import DocumentRecord, ModelRunRecord, PipelineResults
@@ -177,10 +178,15 @@ class PipelineRunner:
         for start in range(0, len(pending), group_size):
             group = pending[start : start + group_size]
             batch_t0 = time.time()
-            # profiler windows must stay short: capture the first batch only
-            profile_cm = device_profile() if start == 0 else contextlib.nullcontext()
-            try:
-                with self.tracer.span("batch"), profile_cm:
+            # profiler windows must stay short: capture the first batch only.
+            # cms are built inside run_batch so a retry gets fresh instances
+            # (a generator-backed cm cannot be re-entered)
+            make_profile_cm = (
+                device_profile if start == 0 else contextlib.nullcontext
+            )
+
+            def run_batch():
+                with self.tracer.span("batch"), make_profile_cm():
                     if cfg.approach == "mapreduce_hierarchical" and tree is not None:
                         roots, docs_fallback = [], []
                         for name in group:
@@ -202,9 +208,17 @@ class PipelineRunner:
                             results.extend(
                                 zip(docs_fallback, strategy.summarize_batch(texts))
                             )
-                    else:
-                        texts = [ds.read_doc(n) for n in group]
-                        results = list(zip(group, strategy.summarize_batch(texts)))
+                        return results
+                    texts = [ds.read_doc(n) for n in group]
+                    return list(zip(group, strategy.summarize_batch(texts)))
+
+            try:
+                results = call_with_retries(
+                    run_batch,
+                    max_retries=cfg.max_batch_retries,
+                    backoff=cfg.retry_backoff,
+                    what=f"batch of {len(group)} docs",
+                )
             except Exception as e:
                 logger.error("batch failed (%s): %s", group, e)
                 logger.debug("%s", traceback.format_exc())
