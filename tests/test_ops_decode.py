@@ -55,8 +55,8 @@ def test_decode_kernel_ignores_past_fill_garbage():
 
 def test_supports_decode():
     assert supports_decode(1152, 128)
-    assert not supports_decode(1152, 64)
-    assert not supports_decode(1151, 128)
+    assert not supports_decode(1152, 64)  # head_dim not a lane multiple
+    assert supports_decode(1151, 128)     # any C via ceil-div grid
 
 
 def test_engine_decode_kernel_path_matches_dense_cpu():

@@ -7,25 +7,32 @@ from vnsum_tpu.models.llama import _attention, prefill_attention_mask
 from vnsum_tpu.ops.flash_attention import flash_prefill_attention, supports_flash
 
 
-def make_qkv(B, S, C, H, KV, hd, seed=0):
+def make_case(L, B, S, C, H, KV, hd, seed=0):
     kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
     q = jax.random.normal(kq, (B, S, H, hd), jnp.float32)
-    k = jnp.zeros((B, KV, C, hd), jnp.float32)  # cache-native layout
-    v = jnp.zeros((B, KV, C, hd), jnp.float32)
+    k_all = jnp.zeros((L, B, KV, C, hd), jnp.float32)
+    v_all = jnp.zeros((L, B, KV, C, hd), jnp.float32)
     # fill only the prefill region like the engine does
-    k = k.at[:, :, :S].set(jax.random.normal(kk, (B, KV, S, hd), jnp.float32))
-    v = v.at[:, :, :S].set(jax.random.normal(kv, (B, KV, S, hd), jnp.float32))
-    return q, k, v
+    k_all = k_all.at[:, :, :, :S].set(
+        jax.random.normal(kk, (L, B, KV, S, hd), jnp.float32)
+    )
+    v_all = v_all.at[:, :, :, :S].set(
+        jax.random.normal(kv, (L, B, KV, S, hd), jnp.float32)
+    )
+    return q, k_all, v_all
 
 
+@pytest.mark.parametrize("layer", [0, 1])
 @pytest.mark.parametrize("pads", [[0, 0], [3, 17]])
-def test_flash_matches_dense(pads):
-    B, S, C, H, KV, hd = 2, 32, 64, 4, 2, 128
-    q, k, v = make_qkv(B, S, C, H, KV, hd)
+def test_flash_matches_dense(layer, pads):
+    L, B, S, C, H, KV, hd = 2, 2, 32, 64, 4, 2, 128
+    q, k_all, v_all = make_case(L, B, S, C, H, KV, hd, seed=layer)
     pad = jnp.asarray(pads, jnp.int32)
     mask = prefill_attention_mask(pad, S, C)
-    dense = _attention(q, k, v, mask, H // KV)
-    flash = flash_prefill_attention(q, k, v, pad, H // KV, interpret=True)
+    dense = _attention(q, k_all[layer], v_all[layer], mask, H // KV)
+    flash = flash_prefill_attention(
+        q, k_all, v_all, layer, pad, H // KV, interpret=True
+    )
     # compare only non-pad rows (pad rows are garbage on both paths)
     for b in range(B):
         np.testing.assert_allclose(
@@ -36,15 +43,31 @@ def test_flash_matches_dense(pads):
         )
 
 
-def test_flash_multiple_k_blocks():
-    # force several K blocks (block picking lands on 64/32 divisors)
-    B, S, C, H, KV, hd = 1, 64, 192, 2, 1, 128
-    q, k, v = make_qkv(B, S, C, H, KV, hd, seed=3)
+def test_flash_ragged_blocks():
+    """S and C with NO large divisors: ceil-div grid + tail masking must
+    still match dense (the old divisor-picker collapsed to 32-wide blocks
+    at such shapes)."""
+    L, B, S, C, H, KV, hd = 1, 1, 45, 61, 2, 1, 128
+    q, k_all, v_all = make_case(L, B, S, C, H, KV, hd, seed=3)
     pad = jnp.asarray([5], jnp.int32)
     mask = prefill_attention_mask(pad, S, C)
-    dense = _attention(q, k, v, mask, H // KV)
+    dense = _attention(q, k_all[0], v_all[0], mask, H // KV)
     flash = flash_prefill_attention(
-        q, k, v, pad, H // KV, block_q=32, block_k=64, interpret=True
+        q, k_all, v_all, 0, pad, H // KV, block_q=16, block_k=16, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense)[0, 5:], np.asarray(flash)[0, 5:], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_multiple_k_blocks():
+    L, B, S, C, H, KV, hd = 1, 1, 64, 192, 2, 1, 128
+    q, k_all, v_all = make_case(L, B, S, C, H, KV, hd, seed=3)
+    pad = jnp.asarray([5], jnp.int32)
+    mask = prefill_attention_mask(pad, S, C)
+    dense = _attention(q, k_all[0], v_all[0], mask, H // KV)
+    flash = flash_prefill_attention(
+        q, k_all, v_all, 0, pad, H // KV, block_q=32, block_k=64, interpret=True
     )
     np.testing.assert_allclose(
         np.asarray(dense)[0, 5:], np.asarray(flash)[0, 5:], rtol=2e-5, atol=2e-5
@@ -53,9 +76,8 @@ def test_flash_multiple_k_blocks():
 
 def test_supports_flash():
     assert supports_flash(1024, 1152, 128)
-    assert not supports_flash(1024, 1152, 64)   # head_dim not a lane multiple
-    assert not supports_flash(1001, 1152, 128)  # S has no block divisor
-    assert supports_flash(64, 1088, 128)
+    assert supports_flash(1001, 1153, 256)  # any S/C via ceil-div grids
+    assert not supports_flash(1024, 1152, 64)  # head_dim not a lane multiple
 
 
 def test_forward_remat_with_attention_fn():
@@ -78,9 +100,9 @@ def test_forward_remat_with_attention_fn():
 
 
 def test_unsupported_head_dim_raises():
-    B, S, C, H, KV, hd = 1, 8, 16, 2, 1, 64
-    q, k, v = make_qkv(B, S, C, H, KV, hd)
+    L, B, S, C, H, KV, hd = 1, 1, 8, 16, 2, 1, 64
+    q, k_all, v_all = make_case(L, B, S, C, H, KV, hd)
     with pytest.raises(ValueError):
         flash_prefill_attention(
-            q, k, v, jnp.zeros((1,), jnp.int32), 2, interpret=True
+            q, k_all, v_all, 0, jnp.zeros((1,), jnp.int32), 2
         )

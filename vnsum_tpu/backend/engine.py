@@ -171,18 +171,18 @@ class TpuBackend:
                 )
             positions = prefill_positions(pad_lens, S)
             mask = prefill_attention_mask(pad_lens, S, C)
-            attention_fn = None
+            prefill_stacked_fn = None
             if use_flash:
                 from ..ops.flash_attention import flash_prefill_attention
 
-                def attention_fn(q, k_cache, v_cache, _mask, q_per_kv):
+                def prefill_stacked_fn(q, k_all, v_all, layer_idx):
                     return flash_prefill_attention(
-                        q, k_cache, v_cache, pad_lens, q_per_kv
+                        q, k_all, v_all, layer_idx, pad_lens, cfg.q_per_kv
                     )
 
             logits, cache = forward(
                 params, cfg, tokens, positions, cache, 0, mask,
-                last_only=True, attention_fn=attention_fn,
+                last_only=True, stacked_attention_fn=prefill_stacked_fn,
             )
             key = jax.random.key(seed)
             key, sub = jax.random.split(key)

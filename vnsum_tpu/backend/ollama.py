@@ -11,10 +11,10 @@ multi-worker Ollama server actually sees concurrent requests.
 """
 from __future__ import annotations
 
-import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..core.config import GenerationConfig
+from ..core.faults import call_with_retries
 from ..core.logging import get_logger
 from ..text.cleaning import clean_thinking_tokens
 from ..text.tokenizer import whitespace_token_count
@@ -73,36 +73,33 @@ class OllamaBackend:
             "think": False,
             "options": options,
         }
-        # retry transient failures with exponential backoff — the reference
-        # has no retries anywhere (SURVEY.md §5 "Failure detection"), so one
-        # dropped connection voids a whole document there
-        last_exc: Exception | None = None
-        for attempt in range(self.max_retries + 1):
-            try:
-                resp = requests.post(
-                    f"{self.url}/api/generate", json=payload, timeout=self.timeout
-                )
-                resp.raise_for_status()
-                text = resp.json()["response"]
-                return clean_thinking_tokens(text) if self.clean_output else text
-            except requests.ConnectionError as e:
-                # NOT requests.Timeout: with the 600 s read timeout a hung
-                # server would stall ~40 min/prompt across retries
-                last_exc = e
-            except requests.HTTPError as e:
+        def attempt() -> str:
+            resp = requests.post(
+                f"{self.url}/api/generate", json=payload, timeout=self.timeout
+            )
+            resp.raise_for_status()
+            text = resp.json()["response"]
+            return clean_thinking_tokens(text) if self.clean_output else text
+
+        def transient(e: Exception) -> bool:
+            # ConnectionError yes; NOT requests.Timeout (with the 600 s read
+            # timeout a hung server would stall ~40 min/prompt across
+            # retries); HTTP 5xx, 429 (load shed), 408 (request timeout)
+            if isinstance(e, requests.HTTPError):
                 status = e.response.status_code if e.response is not None else 0
-                # 5xx, 429 (load shed), 408 (request timeout) are transient
-                if status < 500 and status not in (408, 429):
-                    raise
-                last_exc = e
-            if attempt < self.max_retries:
-                delay = self.retry_backoff * (2**attempt)
-                logger.warning(
-                    "ollama call failed (%s); retry %d/%d in %.1fs",
-                    last_exc, attempt + 1, self.max_retries, delay,
-                )
-                time.sleep(delay)
-        raise last_exc  # type: ignore[misc]
+                return status >= 500 or status in (408, 429)
+            return isinstance(e, requests.ConnectionError)
+
+        # the reference has no retries anywhere (SURVEY.md §5 "Failure
+        # detection"), so one dropped connection voids a whole document there
+        return call_with_retries(
+            attempt,
+            max_retries=self.max_retries,
+            backoff=self.retry_backoff,
+            retryable=(requests.ConnectionError, requests.HTTPError),
+            should_retry=transient,
+            what="ollama call",
+        )
 
     def generate(
         self,

@@ -75,12 +75,16 @@ class FaultPlan:
 
 
 class FaultInjectingBackend:
-    """Wrap a Backend; inject faults per the plan on each generate() call."""
+    """Wrap a Backend; inject faults per the plan on each generate() call.
+
+    ``name`` is preserved from the wrapped backend (pipeline preflight
+    dispatches on it); ``label`` carries the decorated form for logs."""
 
     def __init__(self, inner, plan: FaultPlan) -> None:
         self.inner = inner
         self.plan = plan
-        self.name = f"{inner.name}+faults"
+        self.name = inner.name
+        self.label = f"{inner.name}+faults"
 
     def generate(self, prompts, **kw):
         rule = self.plan.check()
@@ -110,16 +114,21 @@ def call_with_retries(
     max_retries: int,
     backoff: float = 1.0,
     retryable: tuple[type[BaseException], ...] = (Exception,),
+    should_retry=None,
     what: str = "call",
 ):
     """Run fn(); on a retryable failure wait backoff * 2^attempt and rerun,
     up to max_retries extra attempts (negative clamps to 0 — fn always runs
-    at least once). Re-raises the last failure."""
+    at least once). ``should_retry(exc) -> bool`` refines the class filter
+    (e.g. retry only 5xx HTTP errors); a non-retryable failure re-raises
+    immediately. Re-raises the last failure."""
     max_retries = max(max_retries, 0)
     for attempt in range(max_retries + 1):
         try:
             return fn()
         except retryable as e:
+            if should_retry is not None and not should_retry(e):
+                raise
             if attempt >= max_retries:
                 raise
             delay = backoff * (2 ** attempt)
@@ -137,7 +146,8 @@ class RetryingBackend:
         self.inner = inner
         self.max_retries = max_retries
         self.backoff = backoff
-        self.name = f"{inner.name}+retry"
+        self.name = inner.name  # preflight dispatches on the backend kind
+        self.label = f"{inner.name}+retry"
 
     def generate(self, prompts, **kw):
         return call_with_retries(

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import _LANES, _NEG, _pick_block
+from .flash_attention import _LANES, _NEG
 
 
 def _kernel(
@@ -134,7 +134,8 @@ def _pick_block_b(batch: int) -> int:
 
 
 def supports_decode(cache_len: int, head_dim: int) -> bool:
-    return head_dim % _LANES == 0 and _pick_block(cache_len, 128) is not None
+    """Ceil-div grid handles any C; only lane-aligned head dims matter."""
+    return head_dim % _LANES == 0
 
 
 @functools.partial(
@@ -158,13 +159,13 @@ def flash_decode_attention(
     L, _, KV, C, _ = k_all.shape
     if S != 1:
         raise ValueError(f"decode kernel is single-token (S=1), got S={S}")
-    bk = _pick_block(C, block_k)
-    if bk is None or (hd % _LANES and not interpret):
-        raise ValueError(f"unsupported decode shapes C={C} hd={hd}")
+    if hd % _LANES and not interpret:
+        raise ValueError(f"unsupported decode head_dim={hd}")
+    bk = min(block_k, C)
     bb = _pick_block_b(B)
 
     qg = q.reshape(B // bb, bb, KV, q_per_kv, hd)
-    grid = (B // bb, C // bk)
+    grid = (B // bb, pl.cdiv(C, bk))
 
     def kv_index(b, j, lidx, pad, fill, blk=bk):
         # clamp past-fill blocks onto the fill block: consecutive grid steps

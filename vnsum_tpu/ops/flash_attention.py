@@ -6,13 +6,19 @@ far below the reference's 12k-token chunks (SURVEY.md §5). This kernel
 computes attention blockwise with online-softmax scratch accumulators, so
 VMEM holds only (BQ × BK) score tiles and HBM never sees a score tensor:
 
-- grid (B, H, S/BQ, C/BK), K-block innermost; scratch (acc, m, l) carries the
-  running softmax across K blocks; output block written once on the last;
-- causal + left-pad masking fused into the kernel (same semantics as
-  models.llama.prefill_attention_mask: pad_b <= j <= i), with pad lengths
-  delivered via scalar prefetch;
-- GQA folded into the index map: query head h reads KV head h // q_per_kv —
-  no repeated K/V in memory;
+- grid (B, H, ⌈S/BQ⌉, ⌈C/BK⌉), K-block innermost; scratch (acc, m, l)
+  carries the running softmax across K blocks; output written on the last;
+- **ceil-division grids with masked tails**: block sizes stay at MXU-friendly
+  512 for ANY S/C. An earlier divisor-only picker collapsed to 32-wide
+  K blocks at C=2080 (8 KB DMAs) and the kernel ran 60% of total profile
+  time — tail masking costs one wasted partial block instead;
+- **consumes the FULL stacked cache [L, B, KV, C, hd]** like the decode twin
+  (ops/decode_attention.py): the layer index arrives via scalar prefetch and
+  steers the index_map, eliminating the per-layer 2×(B·C·hd·KV) extraction
+  copies XLA otherwise materializes inside the layer scan;
+- causal + left-pad masking fused (same semantics as
+  models.llama.prefill_attention_mask: pad_b <= j <= i);
+- GQA folded into the index map: query head h reads KV head h // q_per_kv;
 - blocks strictly above the causal diagonal skip their FLOPs entirely.
 
 Inference-only (no VJP); training uses dense or ring attention.
@@ -31,10 +37,11 @@ _LANES = 128
 
 
 def _kernel(
+    lidx_ref,  # [1] int32 (scalar prefetch, SMEM) — layer to read
     pad_ref,   # [B] int32 (scalar prefetch, SMEM)
     q_ref,     # [1, 1, BQ, hd]
-    k_ref,     # [1, 1, BK, hd]
-    v_ref,     # [1, 1, BK, hd]
+    k_ref,     # [1, 1, 1, BK, hd]
+    v_ref,     # [1, 1, 1, BK, hd]
     o_ref,     # [1, 1, BQ, hd]
     acc_ref,   # [BQ, hd] f32
     m_ref,     # [BQ, LANES] f32
@@ -42,6 +49,7 @@ def _kernel(
     *,
     block_q: int,
     block_k: int,
+    seq_len: int,
     scale: float,
 ):
     b = pl.program_id(0)
@@ -62,8 +70,8 @@ def _kernel(
     @pl.when(k_start <= q_start + block_q - 1)
     def _compute():
         qb = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0, 0].astype(jnp.float32)
 
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
@@ -73,7 +81,10 @@ def _kernel(
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         pad = pad_ref[b]
-        mask = (k_pos <= q_pos) & (k_pos >= pad)
+        # k_pos <= q_pos also kills the masked tail of a partial K block
+        # (those slots have k_pos >= seq_len > any valid q_pos); q_pos of a
+        # partial Q-block tail produces garbage rows the caller never reads
+        mask = (k_pos <= q_pos) & (k_pos >= pad) & (q_pos < seq_len)
         s = jnp.where(mask, s, _NEG)
 
         m_prev = m_ref[:, :1]                       # [BQ, 1]
@@ -97,20 +108,10 @@ def _kernel(
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
-def _pick_block(n: int, preferred: int) -> int | None:
-    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
-        if b <= preferred and n % b == 0:
-            return b
-    return None
-
-
 def supports_flash(seq_len: int, cache_len: int, head_dim: int) -> bool:
-    """Shapes the kernel can tile: hd a lane multiple, dims block-divisible."""
-    return (
-        head_dim % _LANES == 0
-        and _pick_block(seq_len, 512) is not None
-        and _pick_block(cache_len, 512) is not None
-    )
+    """Ceil-div grids handle any S/C; only the lane-aligned head dim is
+    load-bearing on real hardware."""
+    return head_dim % _LANES == 0
 
 
 @functools.partial(
@@ -118,10 +119,11 @@ def supports_flash(seq_len: int, cache_len: int, head_dim: int) -> bool:
     static_argnames=("q_per_kv", "block_q", "block_k", "interpret"),
 )
 def flash_prefill_attention(
-    q: jax.Array,         # [B, S, H, hd]
-    k: jax.Array,         # [B, KV, C, hd] — cache-native layout, no transpose
-    v: jax.Array,         # [B, KV, C, hd]
-    pad_lens: jax.Array,  # [B] int32 — left-pad per sequence
+    q: jax.Array,          # [B, S, H, hd]
+    k_all: jax.Array,      # [L, B, KV, C, hd] — FULL stacked cache
+    v_all: jax.Array,      # [L, B, KV, C, hd]
+    layer_idx: jax.Array,  # scalar int32
+    pad_lens: jax.Array,   # [B] int32 — left-pad per sequence
     q_per_kv: int,
     *,
     block_q: int = 512,
@@ -129,42 +131,44 @@ def flash_prefill_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """Returns [B, S, H, hd]; semantics match _attention with the prefill
-    mask (pad_b <= j <= i over cache slots)."""
+    mask (pad_b <= j <= i over cache slots) on cache layer ``layer_idx``."""
     B, S, H, hd = q.shape
-    C = k.shape[2]
-    bq = _pick_block(S, block_q)
-    bk = _pick_block(C, block_k)
-    if bq is None or bk is None or hd % _LANES:
-        raise ValueError(f"unsupported flash shapes S={S} C={C} hd={hd}")
+    L, _, KV, C, _ = k_all.shape
+    if hd % _LANES and not interpret:
+        raise ValueError(f"unsupported flash head_dim={hd}")
+    bq = min(block_q, S)
+    bk = min(block_k, C)
 
     qt = q.transpose(0, 2, 1, 3)   # [B, H, S, hd]
-    kt = k
-    vt = v
 
-    grid = (B, H, S // bq, C // bk)
+    grid = (B, H, pl.cdiv(S, bq), pl.cdiv(C, bk))
     kernel = functools.partial(
-        _kernel, block_q=bq, block_k=bk, scale=1.0 / (hd ** 0.5)
+        _kernel, block_q=bq, block_k=bk, seq_len=S, scale=1.0 / (hd ** 0.5)
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
                 pl.BlockSpec(
-                    (1, 1, bq, hd), lambda b, h, i, j, p: (b, h, i, 0)
+                    (1, 1, bq, hd), lambda b, h, i, j, lidx, pad: (b, h, i, 0)
                 ),
                 pl.BlockSpec(
-                    (1, 1, bk, hd),
-                    lambda b, h, i, j, p, g=q_per_kv: (b, h // g, j, 0),
+                    (1, 1, 1, bk, hd),
+                    lambda b, h, i, j, lidx, pad, g=q_per_kv: (
+                        lidx[0], b, h // g, j, 0
+                    ),
                 ),
                 pl.BlockSpec(
-                    (1, 1, bk, hd),
-                    lambda b, h, i, j, p, g=q_per_kv: (b, h // g, j, 0),
+                    (1, 1, 1, bk, hd),
+                    lambda b, h, i, j, lidx, pad, g=q_per_kv: (
+                        lidx[0], b, h // g, j, 0
+                    ),
                 ),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, bq, hd), lambda b, h, i, j, p: (b, h, i, 0)
+                (1, 1, bq, hd), lambda b, h, i, j, lidx, pad: (b, h, i, 0)
             ),
             scratch_shapes=[
                 pltpu.VMEM((bq, hd), jnp.float32),
@@ -174,5 +178,11 @@ def flash_prefill_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
         interpret=interpret,
-    )(pad_lens.astype(jnp.int32), qt, kt, vt)
+    )(
+        jnp.asarray(layer_idx, jnp.int32).reshape(1),
+        pad_lens.astype(jnp.int32),
+        qt,
+        k_all,
+        v_all,
+    )
     return out.transpose(0, 2, 1, 3)

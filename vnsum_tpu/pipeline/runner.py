@@ -29,6 +29,14 @@ from ..text import DocumentTree, clean_thinking_tokens
 logger = get_logger("vnsum.pipeline")
 
 
+# error classes a batch retry can never fix (programming or input errors,
+# not transient device/network state)
+_PERMANENT_ERRORS = (
+    FileNotFoundError, TypeError, ValueError, KeyError, AttributeError,
+    IndexError, NotImplementedError,
+)
+
+
 def model_name_safe(model: str) -> str:
     """'llama3.2:3b' -> 'llama3_2_3b' (ref :170, :326)."""
     return model.replace(":", "_").replace(".", "_")
@@ -217,6 +225,9 @@ class PipelineRunner:
                     run_batch,
                     max_retries=cfg.max_batch_retries,
                     backoff=cfg.retry_backoff,
+                    # deterministic host-side bugs fail fast; re-running a
+                    # multi-minute device batch can't fix a TypeError
+                    should_retry=lambda e: not isinstance(e, _PERMANENT_ERRORS),
                     what=f"batch of {len(group)} docs",
                 )
             except Exception as e:
