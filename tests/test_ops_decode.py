@@ -15,20 +15,20 @@ def make_case(L, B, KV, C, H, hd, seed=0):
     q = jax.random.normal(kq, (B, 1, H, hd), jnp.float32)
     k_all = jax.random.normal(kk, (L, B, KV, C, hd), jnp.float32)
     v_all = jax.random.normal(kv, (L, B, KV, C, hd), jnp.float32)
-    return q, k_all, v_all
+    return q, {"k": k_all, "v": v_all}
 
 
 @pytest.mark.parametrize("layer", [0, 2])
 @pytest.mark.parametrize("fill,pads", [(37, [0, 5]), (63, [0, 0]), (8, [3, 8])])
 def test_decode_kernel_matches_dense(layer, fill, pads):
     L, B, KV, C, H, hd = 3, 2, 2, 64, 4, 128
-    q, k_all, v_all = make_case(L, B, KV, C, H, hd, seed=layer)
+    q, cache = make_case(L, B, KV, C, H, hd, seed=layer)
     pad = jnp.asarray(pads, jnp.int32)
 
     mask = decode_attention_mask(pad, fill, C)
-    dense = _attention(q, k_all[layer], v_all[layer], mask, H // KV)
+    dense = _attention(q, cache["k"][layer], cache["v"][layer], mask, H // KV)
     kernel = flash_decode_attention(
-        q, k_all, v_all, layer, pad, fill, H // KV, block_k=16, interpret=True
+        q, cache, layer, pad, fill, H // KV, block_k=16, interpret=True
     )
     np.testing.assert_allclose(
         np.asarray(dense), np.asarray(kernel), rtol=2e-5, atol=2e-5
@@ -38,19 +38,91 @@ def test_decode_kernel_matches_dense(layer, fill, pads):
 def test_decode_kernel_ignores_past_fill_garbage():
     """Slots past fill must not leak in even if they hold huge values."""
     L, B, KV, C, H, hd = 1, 1, 1, 32, 2, 128
-    q, k_all, v_all = make_case(L, B, KV, C, H, hd, seed=7)
+    q, cache = make_case(L, B, KV, C, H, hd, seed=7)
     fill = 9
-    poisoned_v = v_all.at[:, :, :, fill + 1 :, :].set(1e9)
-    poisoned_k = k_all.at[:, :, :, fill + 1 :, :].set(30.0)  # huge scores
+    poisoned = {
+        "k": cache["k"].at[:, :, :, fill + 1 :, :].set(30.0),  # huge scores
+        "v": cache["v"].at[:, :, :, fill + 1 :, :].set(1e9),
+    }
     pad = jnp.zeros((B,), jnp.int32)
     clean = flash_decode_attention(
-        q, k_all, v_all, 0, pad, fill, H // KV, block_k=8, interpret=True
+        q, cache, 0, pad, fill, H // KV, block_k=8, interpret=True
     )
     poisoned = flash_decode_attention(
-        q, poisoned_k, poisoned_v, 0, pad, fill, H // KV, block_k=8,
+        q, poisoned, 0, pad, fill, H // KV, block_k=8,
         interpret=True,
     )
     np.testing.assert_allclose(np.asarray(clean), np.asarray(poisoned))
+
+
+def quantize_case(cache):
+    """Round-trip the float case through the int8 cache format."""
+    from vnsum_tpu.models.llama import _quantize_kv
+
+    k8, ks = jax.vmap(_quantize_kv)(cache["k"])  # vmap over L
+    v8, vs = jax.vmap(_quantize_kv)(cache["v"])
+    return {"k": k8, "v": v8, "ks": ks, "vs": vs}
+
+
+@pytest.mark.parametrize("fill,pads", [(37, [0, 5]), (8, [3, 8])])
+def test_decode_kernel_int8_cache_matches_dequantized_dense(fill, pads):
+    """The in-kernel dequant (scores x ks, probs x vs) must equal dense
+    attention over the explicitly dequantized cache."""
+    from vnsum_tpu.models.llama import dequantize_cache_layer
+
+    L, B, KV, C, H, hd = 2, 2, 2, 64, 4, 128
+    q, cache = make_case(L, B, KV, C, H, hd, seed=11)
+    qcache = quantize_case(cache)
+    pad = jnp.asarray(pads, jnp.int32)
+
+    kd, vd = dequantize_cache_layer(qcache, 1)
+    mask = decode_attention_mask(pad, fill, C)
+    dense = _attention(q, kd, vd, mask, H // KV)
+    kernel = flash_decode_attention(
+        q, qcache, 1, pad, fill, H // KV, block_k=16, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(kernel), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_prefill_kernel_int8_cache_matches_dequantized_dense():
+    from vnsum_tpu.models.llama import (
+        dequantize_cache_layer,
+        prefill_attention_mask,
+    )
+    from vnsum_tpu.ops.flash_attention import flash_prefill_attention
+
+    L, B, S, C, KV, H, hd = 2, 2, 32, 48, 2, 4, 128
+    kq = jax.random.key(21)
+    q = jax.random.normal(kq, (B, S, H, hd), jnp.float32)
+    _, cache = make_case(L, B, KV, C, H, hd, seed=21)
+    qcache = quantize_case(cache)
+    pad = jnp.asarray([0, 7], jnp.int32)
+
+    kd, vd = dequantize_cache_layer(qcache, 0)
+    mask = prefill_attention_mask(pad, S, C)
+    dense = _attention(q, kd, vd, mask, H // KV)
+    flash = flash_prefill_attention(
+        q, qcache, 0, pad, H // KV, block_q=16, block_k=16, interpret=True
+    )
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(dense)[b, int(pad[b]):],
+            np.asarray(flash)[b, int(pad[b]):],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_int8_cache_quantization_roundtrip_accuracy():
+    """Per-(token, head) scales keep relative error ~1/127."""
+    from vnsum_tpu.models.llama import _quantize_kv
+
+    x = jax.random.normal(jax.random.key(3), (2, 4, 16, 128), jnp.float32) * 5
+    q8, s = _quantize_kv(x)
+    deq = q8.astype(jnp.float32) * s[..., None]
+    err = jnp.abs(deq - x).max() / jnp.abs(x).max()
+    assert float(err) < 1.5 / 127
 
 
 def test_supports_decode():
@@ -83,9 +155,9 @@ def test_engine_decode_kernel_path_matches_dense_cpu():
     mask_t = decode_attention_mask(pad, S + t, C)
     pos = (S - pad) + t
 
-    def stacked(q, k_all, v_all, layer_idx):
+    def stacked(q, cache, layer_idx):
         return flash_decode_attention(
-            q, k_all, v_all, layer_idx, pad, S + t, cfg.q_per_kv,
+            q, cache, layer_idx, pad, S + t, cfg.q_per_kv,
             block_k=8, interpret=True,
         )
 

@@ -19,19 +19,19 @@ def make_case(L, B, S, C, H, KV, hd, seed=0):
     v_all = v_all.at[:, :, :, :S].set(
         jax.random.normal(kv, (L, B, KV, S, hd), jnp.float32)
     )
-    return q, k_all, v_all
+    return q, {"k": k_all, "v": v_all}
 
 
 @pytest.mark.parametrize("layer", [0, 1])
 @pytest.mark.parametrize("pads", [[0, 0], [3, 17]])
 def test_flash_matches_dense(layer, pads):
     L, B, S, C, H, KV, hd = 2, 2, 32, 64, 4, 2, 128
-    q, k_all, v_all = make_case(L, B, S, C, H, KV, hd, seed=layer)
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=layer)
     pad = jnp.asarray(pads, jnp.int32)
     mask = prefill_attention_mask(pad, S, C)
-    dense = _attention(q, k_all[layer], v_all[layer], mask, H // KV)
+    dense = _attention(q, cache["k"][layer], cache["v"][layer], mask, H // KV)
     flash = flash_prefill_attention(
-        q, k_all, v_all, layer, pad, H // KV, interpret=True
+        q, cache, layer, pad, H // KV, interpret=True
     )
     # compare only non-pad rows (pad rows are garbage on both paths)
     for b in range(B):
@@ -48,12 +48,12 @@ def test_flash_ragged_blocks():
     still match dense (the old divisor-picker collapsed to 32-wide blocks
     at such shapes)."""
     L, B, S, C, H, KV, hd = 1, 1, 45, 61, 2, 1, 128
-    q, k_all, v_all = make_case(L, B, S, C, H, KV, hd, seed=3)
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=3)
     pad = jnp.asarray([5], jnp.int32)
     mask = prefill_attention_mask(pad, S, C)
-    dense = _attention(q, k_all[0], v_all[0], mask, H // KV)
+    dense = _attention(q, cache["k"][0], cache["v"][0], mask, H // KV)
     flash = flash_prefill_attention(
-        q, k_all, v_all, 0, pad, H // KV, block_q=16, block_k=16, interpret=True
+        q, cache, 0, pad, H // KV, block_q=16, block_k=16, interpret=True
     )
     np.testing.assert_allclose(
         np.asarray(dense)[0, 5:], np.asarray(flash)[0, 5:], rtol=2e-5, atol=2e-5
@@ -62,12 +62,12 @@ def test_flash_ragged_blocks():
 
 def test_flash_multiple_k_blocks():
     L, B, S, C, H, KV, hd = 1, 1, 64, 192, 2, 1, 128
-    q, k_all, v_all = make_case(L, B, S, C, H, KV, hd, seed=3)
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=3)
     pad = jnp.asarray([5], jnp.int32)
     mask = prefill_attention_mask(pad, S, C)
-    dense = _attention(q, k_all[0], v_all[0], mask, H // KV)
+    dense = _attention(q, cache["k"][0], cache["v"][0], mask, H // KV)
     flash = flash_prefill_attention(
-        q, k_all, v_all, 0, pad, H // KV, block_q=32, block_k=64, interpret=True
+        q, cache, 0, pad, H // KV, block_q=32, block_k=64, interpret=True
     )
     np.testing.assert_allclose(
         np.asarray(dense)[0, 5:], np.asarray(flash)[0, 5:], rtol=2e-5, atol=2e-5
@@ -101,8 +101,8 @@ def test_forward_remat_with_attention_fn():
 
 def test_unsupported_head_dim_raises():
     L, B, S, C, H, KV, hd = 1, 1, 8, 16, 2, 1, 64
-    q, k_all, v_all = make_case(L, B, S, C, H, KV, hd)
+    q, cache = make_case(L, B, S, C, H, KV, hd)
     with pytest.raises(ValueError):
         flash_prefill_attention(
-            q, k_all, v_all, 0, jnp.zeros((1,), jnp.int32), 2
+            q, cache, 0, jnp.zeros((1,), jnp.int32), 2
         )

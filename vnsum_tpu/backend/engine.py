@@ -86,6 +86,7 @@ class TpuBackend:
         seed: int = 0,
         flash: str | bool = "auto",
         quantize: bool = False,
+        quantize_kv: str | bool = "auto",
     ) -> None:
         self.cfg = model_config or llama32_3b()
         # Pallas flash prefill: "auto" enables it on real TPU only (the
@@ -99,6 +100,21 @@ class TpuBackend:
                 "force an all-gather of the stacked KV cache every step"
             )
         self.flash = bool(flash)
+        # int8 KV cache halves decode-attention HBM traffic; the in-kernel
+        # dequant needs the Pallas path, so "auto" follows flash AND actual
+        # kernel support (head_dim lane alignment — e.g. llama32_1b's
+        # head_dim=64 can't take the kernels, and the dense fallback would
+        # dequantize the whole cache per step)
+        kernels_supported = self.cfg.head_dim % 128 == 0
+        if quantize_kv == "auto":
+            quantize_kv = self.flash and kernels_supported
+        elif quantize_kv and not (self.flash and kernels_supported):
+            raise ValueError(
+                "quantize_kv=True requires the Pallas kernels (flash=True "
+                "and head_dim a multiple of 128); the dense fallback would "
+                "dequantize the whole cache per step"
+            )
+        self.quantize_kv = bool(quantize_kv)
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
         self.mesh = mesh
         self.batch_size = batch_size
@@ -152,9 +168,10 @@ class TpuBackend:
             use_flash_decode = supports_decode(C, cfg.head_dim)
 
         mesh = self.mesh
+        quantize_kv = self.quantize_kv
 
         def generate(params, tokens, pad_lens, seed):
-            cache = init_kv_cache(cfg, B, C)
+            cache = init_kv_cache(cfg, B, C, quantized=quantize_kv)
             if mesh is not None:
                 # pin the cache layout (batch over data, heads over model)
                 # instead of leaving it to GSPMD propagation
@@ -175,9 +192,9 @@ class TpuBackend:
             if use_flash:
                 from ..ops.flash_attention import flash_prefill_attention
 
-                def prefill_stacked_fn(q, k_all, v_all, layer_idx):
+                def prefill_stacked_fn(q, cache, layer_idx):
                     return flash_prefill_attention(
-                        q, k_all, v_all, layer_idx, pad_lens, cfg.q_per_kv
+                        q, cache, layer_idx, pad_lens, cfg.q_per_kv
                     )
 
             logits, cache = forward(
@@ -212,9 +229,9 @@ class TpuBackend:
                 if use_flash_decode:
                     from ..ops.decode_attention import flash_decode_attention
 
-                    def stacked_fn(q, k_all, v_all, layer_idx):
+                    def stacked_fn(q, cache, layer_idx):
                         return flash_decode_attention(
-                            q, k_all, v_all, layer_idx, pad_lens, S + t,
+                            q, cache, layer_idx, pad_lens, S + t,
                             cfg.q_per_kv,
                         )
 

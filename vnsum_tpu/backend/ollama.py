@@ -84,11 +84,14 @@ class OllamaBackend:
         def transient(e: Exception) -> bool:
             # ConnectionError yes; NOT requests.Timeout (with the 600 s read
             # timeout a hung server would stall ~40 min/prompt across
-            # retries); HTTP 5xx, 429 (load shed), 408 (request timeout)
+            # retries); HTTP 5xx, 429 (load shed), 408 (request timeout);
+            # a truncated/garbled 200 body (JSONDecodeError is a ValueError
+            # subclass, KeyError for a body missing "response") is also a
+            # server-side transient
             if isinstance(e, requests.HTTPError):
                 status = e.response.status_code if e.response is not None else 0
                 return status >= 500 or status in (408, 429)
-            return isinstance(e, requests.ConnectionError)
+            return isinstance(e, (requests.ConnectionError, ValueError, KeyError))
 
         # the reference has no retries anywhere (SURVEY.md §5 "Failure
         # detection"), so one dropped connection voids a whole document there
@@ -96,7 +99,10 @@ class OllamaBackend:
             attempt,
             max_retries=self.max_retries,
             backoff=self.retry_backoff,
-            retryable=(requests.ConnectionError, requests.HTTPError),
+            retryable=(
+                requests.ConnectionError, requests.HTTPError, ValueError,
+                KeyError,
+            ),
             should_retry=transient,
             what="ollama call",
         )

@@ -1,8 +1,10 @@
 from .llama import (
     LlamaConfig,
+    dequantize_cache_layer,
     forward,
     init_kv_cache,
     init_params,
+    is_quantized_cache,
     llama32_1b,
     llama32_3b,
     tiny_llama,

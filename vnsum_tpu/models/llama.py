@@ -109,7 +109,9 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     return params
 
 
-def init_kv_cache(cfg: LlamaConfig, batch: int, cache_len: int) -> dict:
+def init_kv_cache(
+    cfg: LlamaConfig, batch: int, cache_len: int, *, quantized: bool = False
+) -> dict:
     """Stacked cache [L, B, KV, C, hd] — KV heads BEFORE the sequence dim.
 
     This is the layout the attention einsums consume directly ((b, kv) as
@@ -117,9 +119,48 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, cache_len: int) -> dict:
     ahead of the heads, XLA inserts whole-cache layout-conversion copies plus
     per-layer extraction copies inside the decode loop — measured ~19 GB of
     pure copy traffic per decode step on a 48×1088 cache, 3× the mandatory
-    weight+cache reads."""
+    weight+cache reads.
+
+    ``quantized=True`` stores K/V as int8 with per-(token, head) float32
+    scales ``ks``/``vs`` [L, B, KV, C] — decode attention streams the whole
+    cache every step, so this halves its HBM traffic (decode attention is
+    the largest decode-phase cost once weights are int8)."""
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, cache_len, cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+    if not quantized:
+        return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+    return {
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "ks": jnp.zeros(shape[:-1], jnp.float32),
+        "vs": jnp.zeros(shape[:-1], jnp.float32),
+    }
+
+
+def is_quantized_cache(cache: dict) -> bool:
+    return "ks" in cache
+
+
+def _quantize_kv(x: jax.Array):
+    """x [B, KV, S, hd] -> (int8 values, f32 scales [B, KV, S])."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale[..., 0]
+
+
+def dequantize_cache_layer(cache: dict, layer_idx) -> tuple[jax.Array, jax.Array]:
+    """Extract layer `layer_idx` as dense float K/V [B, KV, C, hd]."""
+    k = jax.lax.dynamic_index_in_dim(cache["k"], layer_idx, 0, keepdims=False)
+    v = jax.lax.dynamic_index_in_dim(cache["v"], layer_idx, 0, keepdims=False)
+    if not is_quantized_cache(cache):
+        return k, v
+    ks = jax.lax.dynamic_index_in_dim(cache["ks"], layer_idx, 0, keepdims=False)
+    vs = jax.lax.dynamic_index_in_dim(cache["vs"], layer_idx, 0, keepdims=False)
+    return (
+        k.astype(jnp.float32) * ks[..., None],
+        v.astype(jnp.float32) * vs[..., None],
+    )
 
 
 # -- building blocks --------------------------------------------------------
@@ -231,17 +272,18 @@ def _attention(
 
 
 def _block(
-    x, lp, layer_idx, cos, sin, mask, k_all, v_all, write_index,
+    x, lp, layer_idx, cos, sin, mask, cache, write_index,
     cfg: LlamaConfig, attention_fn=None, stacked_attention_fn=None,
 ):
     """One decoder layer.
 
-    ``k_all``/``v_all`` are the FULL stacked caches [L, B, KV, C, hd]; only
-    the [S]-token slice of layer ``layer_idx`` is written (a tiny in-place
-    dynamic_update_slice on the scan carry). Carrying the whole cache and
-    writing the small slice keeps decode HBM traffic at weights+cache-read —
-    emitting per-layer caches as scan outputs would re-materialize the whole
-    ~GB cache every decode step."""
+    ``cache`` holds the FULL stacked caches [L, B, KV, C, hd] (plus
+    per-token scales when int8-quantized); only the [S]-token slice of layer
+    ``layer_idx`` is written (a tiny in-place dynamic_update_slice on the
+    scan carry). Carrying the whole cache and writing the small slice keeps
+    decode HBM traffic at weights+cache-read — emitting per-layer caches as
+    scan outputs would re-materialize the whole ~GB cache every decode
+    step."""
     h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
     q = _proj("bsd,dhk->bshk", h, lp["wq"])
     k = _proj("bsd,dhk->bshk", h, lp["wk"])
@@ -249,19 +291,45 @@ def _block(
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
 
-    k_all = jax.lax.dynamic_update_slice(
-        k_all, k.transpose(0, 2, 1, 3)[None], (layer_idx, 0, 0, write_index, 0)
-    )
-    v_all = jax.lax.dynamic_update_slice(
-        v_all, v.transpose(0, 2, 1, 3)[None], (layer_idx, 0, 0, write_index, 0)
-    )
-    if stacked_attention_fn is not None:
-        # reads the stacked cache in place (Pallas decode kernel): no
-        # per-layer extraction copy materializes
-        attn = stacked_attention_fn(q, k_all, v_all, layer_idx)
+    kt = k.transpose(0, 2, 1, 3)  # [B, KV, S, hd] — cache-native
+    vt = v.transpose(0, 2, 1, 3)
+    if is_quantized_cache(cache):
+        k8, ks = _quantize_kv(kt)
+        v8, vs = _quantize_kv(vt)
+        cache = dict(
+            cache,
+            k=jax.lax.dynamic_update_slice(
+                cache["k"], k8[None], (layer_idx, 0, 0, write_index, 0)
+            ),
+            v=jax.lax.dynamic_update_slice(
+                cache["v"], v8[None], (layer_idx, 0, 0, write_index, 0)
+            ),
+            ks=jax.lax.dynamic_update_slice(
+                cache["ks"], ks[None], (layer_idx, 0, 0, write_index)
+            ),
+            vs=jax.lax.dynamic_update_slice(
+                cache["vs"], vs[None], (layer_idx, 0, 0, write_index)
+            ),
+        )
     else:
-        k_cache = jax.lax.dynamic_index_in_dim(k_all, layer_idx, 0, keepdims=False)
-        v_cache = jax.lax.dynamic_index_in_dim(v_all, layer_idx, 0, keepdims=False)
+        cache = dict(
+            cache,
+            k=jax.lax.dynamic_update_slice(
+                cache["k"], kt[None], (layer_idx, 0, 0, write_index, 0)
+            ),
+            v=jax.lax.dynamic_update_slice(
+                cache["v"], vt[None], (layer_idx, 0, 0, write_index, 0)
+            ),
+        )
+
+    if stacked_attention_fn is not None:
+        # reads the stacked cache in place (Pallas kernels): no per-layer
+        # extraction copy materializes
+        attn = stacked_attention_fn(q, cache, layer_idx)
+    else:
+        k_cache, v_cache = dequantize_cache_layer(cache, layer_idx)
+        k_cache = k_cache.astype(q.dtype)
+        v_cache = v_cache.astype(q.dtype)
         if attention_fn is None:
             attn = _attention(q, k_cache, v_cache, mask, cfg.q_per_kv)
         else:
@@ -273,7 +341,7 @@ def _block(
     gate = _proj("bsd,di->bsi", h, lp["w_gate"])
     up = _proj("bsd,di->bsi", h, lp["w_up"])
     mlp_out = _proj("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"])
-    return x + mlp_out, k_all, v_all
+    return x + mlp_out, cache
 
 
 def forward(
@@ -297,29 +365,29 @@ def forward(
     S=2048 would be ~8 GB on the 128k vocab).
 
     ``attention_fn(q, k_cache, v_cache, mask, q_per_kv)`` overrides the
-    dense cache attention (e.g. the Pallas flash kernel for prefill);
-    ``stacked_attention_fn(q, k_all, v_all, layer_idx)`` overrides it with a
-    consumer of the FULL stacked cache (the Pallas decode kernel) and takes
+    dense cache attention on the extracted (dequantized) layer cache;
+    ``stacked_attention_fn(q, cache, layer_idx)`` overrides it with a
+    consumer of the FULL stacked cache dict (the Pallas kernels) and takes
     precedence."""
     x = _embed_lookup(params["embed"], tokens, cfg.dtype)
     cos, sin = _rope_cos_sin(cfg, positions)
 
     block = _block
     if remat:
-        block = jax.checkpoint(_block, static_argnums=(9, 10, 11))
+        block = jax.checkpoint(_block, static_argnums=(8, 9, 10))
 
     def layer_step(carry, xs):
-        h, k_all, v_all = carry
+        h, cache = carry
         lp, li = xs
-        h, k_all, v_all = block(
-            h, lp, li, cos, sin, mask, k_all, v_all, write_index, cfg,
+        h, cache = block(
+            h, lp, li, cos, sin, mask, cache, write_index, cfg,
             attention_fn, stacked_attention_fn,
         )
-        return (h, k_all, v_all), None
+        return (h, cache), None
 
-    (x, new_k, new_v), _ = jax.lax.scan(
+    (x, new_cache), _ = jax.lax.scan(
         layer_step,
-        (x, kv_cache["k"], kv_cache["v"]),
+        (x, kv_cache),
         (params["layers"], jnp.arange(cfg.n_layers)),
     )
 
@@ -327,7 +395,7 @@ def forward(
         x = x[:, -1:, :]
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = _lm_head_logits(x, params, cfg)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_cache
 
 
 def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, q_per_kv: int):

@@ -19,14 +19,20 @@ no conversion.
 Block geometry matters more than anything here: a first cut that gridded
 over (B, KV, C/BK) issued tens-of-KB DMAs and ran 3x SLOWER than the XLA
 path (92 ms/step) because the pipeline never got deep enough. This version
-grids over (B/BB, C/BK) with each block carrying all KV heads and BB batch
-rows (~MB-scale DMAs); the BB x KV attention groups are computed as an
+grids over (B/BB, ceil(C/BK)) with each block carrying all KV heads and BB
+batch rows (~MB-scale DMAs); the BB x KV attention groups are computed as an
 unrolled loop of small MXU dots against VMEM-resident tiles.
 
 Blocks past the current fill position are elided by clamping the index_map
 (Pallas skips the DMA when consecutive grid steps address the same block)
 and `pl.when` skips their compute, so a step at fill=600 in a C=1152 cache
 reads only ~half the cache.
+
+int8 KV caches (models.llama.init_kv_cache(quantized=True)) stream half the
+bytes again: the kernel loads int8 K/V blocks plus per-(token, head) f32
+scales and folds dequantization into the softmax algebra — scores multiply
+by the K scale per cache slot, and probabilities multiply by the V scale
+before the PV dot (diag-scale commutes through both contractions).
 
 Inference-only (no VJP). The reference has no analog — its decode happens
 inside Ollama (SURVEY.md §1 L1).
@@ -45,21 +51,27 @@ from .flash_attention import _LANES, _NEG
 
 def _kernel(
     lidx_ref,  # [1] int32 (SMEM) — layer to read
-    pad_ref,   # [B] int32 (SMEM) — left-pad per row
     fill_ref,  # [1] int32 (SMEM) — last valid cache slot (inclusive)
-    q_ref,     # [1, BB, KV, G, hd]
-    k_ref,     # [1, BB, KV, BK, hd]
-    v_ref,     # [1, BB, KV, BK, hd]
-    o_ref,     # [1, BB, KV, G, hd]
-    acc_ref,   # [BB, KV * G, hd] f32
-    m_ref,     # [BB, KV * G, LANES] f32
-    l_ref,     # [BB, KV * G, LANES] f32
-    *,
+    *refs,
     block_b: int,
     block_k: int,
     n_kv: int,
     scale: float,
+    quantized: bool,
 ):
+    if quantized:
+        q_ref, pads_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, pads_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
+    # q_ref/o_ref [1, BB*KV, G, hd] (host pre-merges the batch/head dims —
+    # Mosaic supports MERGING leading dims in-kernel but not splitting them,
+    # and tpu.matmul takes a single batch dim); pads_ref [1, BB*KV, 1, BK]
+    # (per-row left-pads pre-broadcast on host: SMEM scalars can't be
+    # stacked into a vector in-kernel); k_ref/v_ref [1, BB, KV, BK, hd];
+    # ks_ref/vs_ref [1, BB, KV, BK]; scratch acc [BB*KV, G, hd],
+    # m/l [BB*KV, G, LANES]
+
     bb = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -75,55 +87,52 @@ def _kernel(
     # skip their compute so the clamped duplicate block isn't double-counted
     @pl.when(j * block_k <= fill)
     def _compute():
-        G = q_ref.shape[3]
+        G = q_ref.shape[2]
+        hd = q_ref.shape[3]
+        BKV = block_b * n_kv
+        # one batched dot over the merged (BB, KV) dim instead of BBxKV
+        # unrolled small dots: the unrolled form was VPU-bound (its softmax
+        # bookkeeping ran once per head) and an int8 cache gave no speedup
+        qb = q_ref[0].astype(jnp.float32)                       # [BKV, G, hd]
+        kb = k_ref[0].astype(jnp.float32).reshape(BKV, block_k, hd)
+        vb = v_ref[0].astype(jnp.float32).reshape(BKV, block_k, hd)
+
+        s = jax.lax.dot_general(
+            qb, kb, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [BKV, G, BK]
+        if quantized:
+            s = s * ks_ref[0].reshape(BKV, 1, block_k)
+
         k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (G, block_k), 1
+            jnp.int32, (BKV, 1, block_k), 2
         )
-        for i in range(block_b):  # static unroll over the row block
-            row_mask = (k_pos >= pad_ref[bb * block_b + i]) & (k_pos <= fill)
-            for h in range(n_kv):  # static unroll over KV heads
-                qb = q_ref[0, i, h].astype(jnp.float32)   # [G, hd]
-                kb = k_ref[0, i, h].astype(jnp.float32)   # [BK, hd]
-                vb = v_ref[0, i, h].astype(jnp.float32)
+        mask = (k_pos >= pads_ref[0]) & (k_pos <= fill)  # [BKV, 1, BK]
+        s = jnp.where(mask, s, _NEG)
 
-                s = jax.lax.dot_general(
-                    qb, kb, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ) * scale  # [G, BK]
-                s = jnp.where(row_mask, s, _NEG)
+        m_prev = m_ref[:, :, :1]                         # [BKV, G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
 
-                g0 = h * G
-                m_prev = m_ref[i, g0 : g0 + G, :1]
-                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-                corr = jnp.exp(m_prev - m_new)
-                p = jnp.exp(s - m_new)
-                p = jnp.where(row_mask, p, 0.0)
-
-                l_ref[i, g0 : g0 + G] = jnp.broadcast_to(
-                    l_ref[i, g0 : g0 + G, :1] * corr
-                    + jnp.sum(p, axis=1, keepdims=True),
-                    (G, l_ref.shape[2]),
-                )
-                acc_ref[i, g0 : g0 + G] = acc_ref[
-                    i, g0 : g0 + G
-                ] * corr + jax.lax.dot_general(
-                    p, vb, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                m_ref[i, g0 : g0 + G] = jnp.broadcast_to(
-                    m_new, (G, m_ref.shape[2])
-                )
+        l_ref[...] = jnp.broadcast_to(
+            l_ref[:, :, :1] * corr + jnp.sum(p, axis=2, keepdims=True),
+            l_ref.shape,
+        )
+        if quantized:
+            p = p * vs_ref[0].reshape(BKV, 1, block_k)
+        pv = jax.lax.dot_general(
+            p, vb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [BKV, G, hd]
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(j == nj - 1)
     def _finalize():
-        G = o_ref.shape[3]
-        for i in range(block_b):
-            for h in range(n_kv):
-                g0 = h * G
-                l = jnp.maximum(l_ref[i, g0 : g0 + G, :1], 1e-30)
-                o_ref[0, i, h] = (
-                    acc_ref[i, g0 : g0 + G] / l
-                ).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:, :, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
 def _pick_block_b(batch: int) -> int:
@@ -143,8 +152,7 @@ def supports_decode(cache_len: int, head_dim: int) -> bool:
 )
 def flash_decode_attention(
     q: jax.Array,          # [B, 1, H, hd]
-    k_all: jax.Array,      # [L, B, KV, C, hd] — FULL stacked cache
-    v_all: jax.Array,      # [L, B, KV, C, hd]
+    cache: dict,           # stacked {"k","v"[, "ks","vs"]} (llama.init_kv_cache)
     layer_idx: jax.Array,  # scalar int32
     pad_lens: jax.Array,   # [B] int32
     fill: jax.Array,       # scalar int32 — last valid slot (inclusive)
@@ -153,8 +161,10 @@ def flash_decode_attention(
     block_k: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
-    """Semantics match _attention(q, cache[layer], mask=pad<=j<=fill);
-    returns [B, 1, H, hd]."""
+    """Semantics match _attention(q, dequantized cache[layer],
+    mask=pad<=j<=fill); returns [B, 1, H, hd]."""
+    k_all, v_all = cache["k"], cache["v"]
+    quantized = "ks" in cache
     B, S, H, hd = q.shape
     L, _, KV, C, _ = k_all.shape
     if S != 1:
@@ -164,48 +174,66 @@ def flash_decode_attention(
     bk = min(block_k, C)
     bb = _pick_block_b(B)
 
-    qg = q.reshape(B // bb, bb, KV, q_per_kv, hd)
+    qg = q.reshape(B // bb, bb * KV, q_per_kv, hd)
+    # per-row left-pads, pre-broadcast to the merged-row block shape (the
+    # kernel can't assemble a vector out of SMEM scalars)
+    pads = jnp.broadcast_to(
+        pad_lens.astype(jnp.int32).reshape(B // bb, bb, 1, 1, 1),
+        (B // bb, bb, KV, 1, bk),
+    ).reshape(B // bb, bb * KV, 1, bk)
     grid = (B // bb, pl.cdiv(C, bk))
 
-    def kv_index(b, j, lidx, pad, fill, blk=bk):
+    def kv_index(b, j, lidx, fill, blk=bk):
         # clamp past-fill blocks onto the fill block: consecutive grid steps
         # then address the same block and Pallas elides the DMA
         return (lidx[0], b, 0, jnp.minimum(j, fill[0] // blk), 0)
 
+    def scale_index(b, j, lidx, fill, blk=bk):
+        return (lidx[0], b, 0, jnp.minimum(j, fill[0] // blk))
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, bb * KV, q_per_kv, hd), lambda b, j, lidx, fill: (b, 0, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, bb * KV, 1, bk), lambda b, j, lidx, fill: (b, 0, 0, 0)
+        ),
+        pl.BlockSpec((1, bb, KV, bk, hd), kv_index),
+        pl.BlockSpec((1, bb, KV, bk, hd), kv_index),
+    ]
+    operands = [qg, pads, k_all, v_all]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bb, KV, bk), scale_index),
+            pl.BlockSpec((1, bb, KV, bk), scale_index),
+        ]
+        operands += [cache["ks"], cache["vs"]]
+
     kernel = functools.partial(
-        _kernel, block_b=bb, block_k=bk, n_kv=KV, scale=1.0 / (hd ** 0.5)
+        _kernel, block_b=bb, block_k=bk, n_kv=KV, scale=1.0 / (hd ** 0.5),
+        quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, bb, KV, q_per_kv, hd),
-                    lambda b, j, lidx, pad, fill: (b, 0, 0, 0, 0),
-                ),
-                pl.BlockSpec((1, bb, KV, bk, hd), kv_index),
-                pl.BlockSpec((1, bb, KV, bk, hd), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, bb, KV, q_per_kv, hd),
-                lambda b, j, lidx, pad, fill: (b, 0, 0, 0, 0),
+                (1, bb * KV, q_per_kv, hd),
+                lambda b, j, lidx, fill: (b, 0, 0, 0),
             ),
             scratch_shapes=[
-                pltpu.VMEM((bb, KV * q_per_kv, hd), jnp.float32),
-                pltpu.VMEM((bb, KV * q_per_kv, _LANES), jnp.float32),
-                pltpu.VMEM((bb, KV * q_per_kv, _LANES), jnp.float32),
+                pltpu.VMEM((bb * KV, q_per_kv, hd), jnp.float32),
+                pltpu.VMEM((bb * KV, q_per_kv, _LANES), jnp.float32),
+                pltpu.VMEM((bb * KV, q_per_kv, _LANES), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B // bb, bb, KV, q_per_kv, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B // bb, bb * KV, q_per_kv, hd), q.dtype),
         interpret=interpret,
     )(
         jnp.asarray(layer_idx, jnp.int32).reshape(1),
-        pad_lens.astype(jnp.int32),
         jnp.asarray(fill, jnp.int32).reshape(1),
-        qg,
-        k_all,
-        v_all,
+        *operands,
     )
     return out.reshape(B, 1, H, hd)

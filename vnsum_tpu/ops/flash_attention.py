@@ -39,20 +39,26 @@ _LANES = 128
 def _kernel(
     lidx_ref,  # [1] int32 (scalar prefetch, SMEM) — layer to read
     pad_ref,   # [B] int32 (scalar prefetch, SMEM)
-    q_ref,     # [1, 1, BQ, hd]
-    k_ref,     # [1, 1, 1, BK, hd]
-    v_ref,     # [1, 1, 1, BK, hd]
-    o_ref,     # [1, 1, BQ, hd]
-    acc_ref,   # [BQ, hd] f32
-    m_ref,     # [BQ, LANES] f32
-    l_ref,     # [BQ, LANES] f32
-    *,
+    *refs,
     block_q: int,
     block_k: int,
     seq_len: int,
     scale: float,
+    quantized: bool,
+    q_per_kv: int,
 ):
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
+    # q_ref/o_ref [1, 1, BQ, hd]; k_ref/v_ref [1, 1, 1, BK, hd];
+    # ks_ref/vs_ref [1, 1, KV, BK] (full KV axis — Mosaic requires the
+    # second-minor block dim be 8-divisible or whole; the head's row is
+    # selected in-kernel); scratch acc [BQ, hd] f32, m/l [BQ, LANES] f32
+
     b = pl.program_id(0)
+    h = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
     nj = pl.num_programs(3)
@@ -77,6 +83,8 @@ def _kernel(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [BQ, BK]
+        if quantized:
+            s = s * ks_ref[0, 0, h // q_per_kv][None, :]
 
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -95,6 +103,8 @@ def _kernel(
         p = jnp.where(mask, p, 0.0)                 # dead rows stay dead
 
         l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        if quantized:
+            p = p * vs_ref[0, 0, h // q_per_kv][None, :]
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -120,8 +130,7 @@ def supports_flash(seq_len: int, cache_len: int, head_dim: int) -> bool:
 )
 def flash_prefill_attention(
     q: jax.Array,          # [B, S, H, hd]
-    k_all: jax.Array,      # [L, B, KV, C, hd] — FULL stacked cache
-    v_all: jax.Array,      # [L, B, KV, C, hd]
+    cache: dict,           # stacked {"k","v"[, "ks","vs"]} (llama.init_kv_cache)
     layer_idx: jax.Array,  # scalar int32
     pad_lens: jax.Array,   # [B] int32 — left-pad per sequence
     q_per_kv: int,
@@ -131,7 +140,10 @@ def flash_prefill_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """Returns [B, S, H, hd]; semantics match _attention with the prefill
-    mask (pad_b <= j <= i over cache slots) on cache layer ``layer_idx``."""
+    mask (pad_b <= j <= i over cache slots) on the (dequantized) cache layer
+    ``layer_idx``."""
+    k_all, v_all = cache["k"], cache["v"]
+    quantized = "ks" in cache
     B, S, H, hd = q.shape
     L, _, KV, C, _ = k_all.shape
     if hd % _LANES and not interpret:
@@ -141,32 +153,36 @@ def flash_prefill_attention(
 
     qt = q.transpose(0, 2, 1, 3)   # [B, H, S, hd]
 
+    def kv_index(b, h, i, j, lidx, pad, g=q_per_kv):
+        return (lidx[0], b, h // g, j, 0)
+
+    def scale_index(b, h, i, j, lidx, pad):
+        return (lidx[0], b, 0, j)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j, lidx, pad: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
+        pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
+    ]
+    operands = [qt, k_all, v_all]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, KV, bk), scale_index),
+            pl.BlockSpec((1, 1, KV, bk), scale_index),
+        ]
+        operands += [cache["ks"], cache["vs"]]
+
     grid = (B, H, pl.cdiv(S, bq), pl.cdiv(C, bk))
     kernel = functools.partial(
-        _kernel, block_q=bq, block_k=bk, seq_len=S, scale=1.0 / (hd ** 0.5)
+        _kernel, block_q=bq, block_k=bk, seq_len=S, scale=1.0 / (hd ** 0.5),
+        quantized=quantized, q_per_kv=q_per_kv,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, bq, hd), lambda b, h, i, j, lidx, pad: (b, h, i, 0)
-                ),
-                pl.BlockSpec(
-                    (1, 1, 1, bk, hd),
-                    lambda b, h, i, j, lidx, pad, g=q_per_kv: (
-                        lidx[0], b, h // g, j, 0
-                    ),
-                ),
-                pl.BlockSpec(
-                    (1, 1, 1, bk, hd),
-                    lambda b, h, i, j, lidx, pad, g=q_per_kv: (
-                        lidx[0], b, h // g, j, 0
-                    ),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, bq, hd), lambda b, h, i, j, lidx, pad: (b, h, i, 0)
             ),
@@ -181,8 +197,6 @@ def flash_prefill_attention(
     )(
         jnp.asarray(layer_idx, jnp.int32).reshape(1),
         pad_lens.astype(jnp.int32),
-        qt,
-        k_all,
-        v_all,
+        *operands,
     )
     return out.transpose(0, 2, 1, 3)

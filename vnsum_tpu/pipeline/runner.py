@@ -104,6 +104,9 @@ class PipelineRunner:
     def preflight(self, backend: Backend) -> None:
         """Backend health check before any work (ref :199-233 checked the
         Ollama server + model availability)."""
+        # .label carries wrapper decorations ("ollama+retry", "fake+faults")
+        # that .name deliberately drops so the dispatch below still works
+        logger.info("backend: %s", getattr(backend, "label", backend.name))
         if backend.name == "ollama":
             models = backend.health_check()
             logger.info("ollama reachable; models: %s", models)
