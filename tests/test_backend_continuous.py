@@ -1,0 +1,108 @@
+"""Continuous scheduling (segmented decode + tail compaction).
+
+Greedy parity: each row's token stream depends only on its own cache, so
+the continuous path must produce byte-identical output to the one-shot
+while_loop path, including when compaction rebatches mid-generation."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vnsum_tpu.backend.engine import TpuBackend
+from vnsum_tpu.core.config import GenerationConfig
+from vnsum_tpu.models import tiny_llama
+
+
+def make_backend(continuous, **kw):
+    return TpuBackend(
+        model_config=tiny_llama(max_seq_len=128),
+        tokenizer="byte",
+        batch_size=8,
+        max_new_tokens=24,
+        seed=1,
+        continuous=continuous,
+        **kw,
+    )
+
+
+PROMPTS = [
+    "văn bản một về kinh tế",
+    "hai",
+    "văn bản thứ ba dài hơn một chút về xã hội",
+    "bốn bốn",
+    "năm năm năm",
+    "sáu",
+]
+
+
+def test_continuous_matches_oneshot_greedy():
+    plain = make_backend(False)
+    cont = make_backend(True, segment_tokens=4, min_batch=1)
+    np.testing.assert_array_equal(
+        plain.generate(PROMPTS), cont.generate(PROMPTS)
+    )
+
+
+def test_continuous_with_ragged_eos_and_compaction():
+    """Force ragged termination by declaring a COMMON token as EOS: rows
+    finish at different steps, compaction must fire, and outputs still
+    match the one-shot path exactly."""
+    # find a token that actually appears early in greedy rollouts
+    probe = make_backend(False)
+    outs = probe.generate(PROMPTS)
+    tok = probe.tok
+    ids = [tok.encode(o, add_bos=False) for o in outs if o]
+    assert ids, "probe produced no output; pick a different seed"
+    # a token from the middle of the longest rollout => some rows hit it
+    # early, others late or never
+    longest = max(ids, key=len)
+    eos_extra = longest[len(longest) // 2]
+    gen = GenerationConfig(eos_ids=(tok.eos_id, eos_extra), max_new_tokens=24)
+
+    plain = make_backend(False)
+    cont = make_backend(True, segment_tokens=4, min_batch=1)
+    a = plain.generate(PROMPTS, config=gen)
+    b = cont.generate(PROMPTS, config=gen)
+    np.testing.assert_array_equal(a, b)
+    # raggedness check: termination steps must differ across rows
+    lens = {len(x) for x in a}
+    assert len(lens) > 1, a
+
+
+def test_compaction_fires_and_is_counted():
+    probe = make_backend(False)
+    outs = probe.generate(PROMPTS)
+    tok = probe.tok
+    longest = max(
+        (tok.encode(o, add_bos=False) for o in outs if o), key=len
+    )
+    gen = GenerationConfig(
+        eos_ids=(tok.eos_id, longest[len(longest) // 2]), max_new_tokens=24
+    )
+    cont = make_backend(True, segment_tokens=2, min_batch=1)
+    cont.generate(PROMPTS, config=gen)
+    assert cont.stats.compactions >= 1
+
+
+def test_continuous_single_prompt():
+    cont = make_backend(True, segment_tokens=4, min_batch=1)
+    plain = make_backend(False)
+    np.testing.assert_array_equal(
+        plain.generate(["một văn bản"]), cont.generate(["một văn bản"])
+    )
+
+
+def test_continuous_respects_mesh_exclusion():
+    """continuous='auto' must stay off under a mesh (per-row gather would
+    fight the data sharding)."""
+    import jax
+
+    from vnsum_tpu.parallel import make_mesh
+
+    if len(jax.devices("cpu")) < 2:
+        pytest.skip("needs 2 cpu devices")
+    mesh = make_mesh({"data": 2, "model": 1}, platform="cpu")
+    be = TpuBackend(
+        model_config=tiny_llama(max_seq_len=128), batch_size=4,
+        max_new_tokens=8, mesh=mesh,
+    )
+    assert be.continuous is False

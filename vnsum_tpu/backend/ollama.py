@@ -11,6 +11,8 @@ multi-worker Ollama server actually sees concurrent requests.
 """
 from __future__ import annotations
 
+import json
+
 from concurrent.futures import ThreadPoolExecutor
 
 from ..core.config import GenerationConfig
@@ -85,13 +87,16 @@ class OllamaBackend:
             # ConnectionError yes; NOT requests.Timeout (with the 600 s read
             # timeout a hung server would stall ~40 min/prompt across
             # retries); HTTP 5xx, 429 (load shed), 408 (request timeout);
-            # a truncated/garbled 200 body (JSONDecodeError is a ValueError
-            # subclass, KeyError for a body missing "response") is also a
-            # server-side transient
+            # a truncated/garbled 200 body (JSONDecodeError, or KeyError for
+            # a body missing "response") is also a server-side transient.
+            # NOT plain ValueError: MissingSchema/InvalidURL subclass it and
+            # are unfixable config errors that must fail fast.
             if isinstance(e, requests.HTTPError):
                 status = e.response.status_code if e.response is not None else 0
                 return status >= 500 or status in (408, 429)
-            return isinstance(e, (requests.ConnectionError, ValueError, KeyError))
+            return isinstance(
+                e, (requests.ConnectionError, json.JSONDecodeError, KeyError)
+            )
 
         # the reference has no retries anywhere (SURVEY.md §5 "Failure
         # detection"), so one dropped connection voids a whole document there
@@ -100,7 +105,9 @@ class OllamaBackend:
             max_retries=self.max_retries,
             backoff=self.retry_backoff,
             retryable=(
-                requests.ConnectionError, requests.HTTPError, ValueError,
+                requests.ConnectionError,
+                requests.HTTPError,
+                json.JSONDecodeError,  # requests' JSONDecodeError subclasses it
                 KeyError,
             ),
             should_retry=transient,

@@ -72,7 +72,6 @@ def _kernel(
     # ks_ref/vs_ref [1, BB, KV, BK]; scratch acc [BB*KV, G, hd],
     # m/l [BB*KV, G, LANES]
 
-    bb = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     fill = fill_ref[0]
