@@ -138,7 +138,12 @@ class TpuBackend:
         # shape changes.
         if continuous == "auto":
             continuous = mesh is None
-        self.continuous = bool(continuous) and mesh is None
+        elif continuous and mesh is not None:
+            raise ValueError(
+                "continuous=True is incompatible with a mesh: per-row "
+                "harvest/compaction gathers fight the data sharding"
+            )
+        self.continuous = bool(continuous)
         self.segment_tokens = max(segment_tokens, 1)
         self.min_batch = max(min_batch, 1)
         self.stats = EngineStats()
@@ -398,21 +403,9 @@ class TpuBackend:
         a half-size (or smaller) program, finished rows are harvested and
         the survivors gathered into it. Greedy output is identical to the
         one-shot path — each row's stream depends only on its own cache."""
-        data_size = 1  # continuous implies mesh is None
-        max_input = self.cfg.max_seq_len - max_new
-        S = _bucket_len(max(len(encoded[i]) for i in group), max_input)
-        B = data_size
-        while B < len(group):
-            B *= 2
-        B = min(B, self.batch_size)
-
-        tokens = np.full((B, S), self.tok.pad_id, dtype=np.int32)
-        pads = np.full((B,), S, dtype=np.int32)
+        tokens, pads, B, S = self._pack_group(group, encoded, max_new)
         rows: list[int | None] = [None] * B
         for row, i in enumerate(group):
-            ids = encoded[i]
-            tokens[row, S - len(ids):] = ids
-            pads[row] = S - len(ids)
             rows[row] = i
 
         prefill = self._get_seg_fn("prefill", B, S, max_new, gen)
@@ -445,7 +438,7 @@ class TpuBackend:
 
             # compact when the survivors fit a half-size program
             B_new = B
-            while B_new // 2 >= max(len(active), self.min_batch, data_size):
+            while B_new // 2 >= max(len(active), self.min_batch, 1):
                 B_new //= 2
             if B_new < B:
                 out_h = np.asarray(out)
@@ -473,6 +466,28 @@ class TpuBackend:
                 results[orig] = self._detok(out_h[r])
 
     # -- public API ------------------------------------------------------
+
+    def _pack_group(self, group, encoded, max_new: int):
+        """Pack one prompt group into a fixed-shape left-padded batch.
+
+        Shared by the one-shot and continuous paths — their greedy-parity
+        guarantee depends on identical bucketing and padding."""
+        max_input = self.cfg.max_seq_len - max_new
+        data_size = self.mesh.shape.get("data", 1) if self.mesh is not None else 1
+        S = _bucket_len(max(len(encoded[i]) for i in group), max_input)
+        # bucket the batch dim too, so a trailing partial group doesn't pay
+        # for all-pad rows up to the full batch_size
+        B = data_size
+        while B < len(group):
+            B *= 2
+        B = min(B, self.batch_size)
+        tokens = np.full((B, S), self.tok.pad_id, dtype=np.int32)
+        pad_lens = np.full((B,), S, dtype=np.int32)
+        for row, i in enumerate(group):
+            ids = encoded[i]
+            tokens[row, S - len(ids):] = ids  # left padding
+            pad_lens[row] = S - len(ids)
+        return tokens, pad_lens, B, S
 
     def generate(
         self,
@@ -518,21 +533,7 @@ class TpuBackend:
             if continuous:
                 self._run_group_continuous(group, encoded, max_new, gen, results)
                 continue
-            S = _bucket_len(
-                max(len(encoded[i]) for i in group), max_input
-            )
-            # bucket the batch dim too, so a trailing partial group doesn't
-            # pay for all-pad rows up to the full batch_size
-            B = data_size
-            while B < len(group):
-                B *= 2
-            B = min(B, self.batch_size)
-            tokens = np.full((B, S), self.tok.pad_id, dtype=np.int32)
-            pad_lens = np.full((B,), S, dtype=np.int32)
-            for row, i in enumerate(group):
-                ids = encoded[i]
-                tokens[row, S - len(ids) :] = ids  # left padding
-                pad_lens[row] = S - len(ids)
+            tokens, pad_lens, B, S = self._pack_group(group, encoded, max_new)
             fn = self._get_fn(B, S, max_new, gen)
             with annotate(f"generate[B={B},S={S}]"):
                 out = np.asarray(fn(self.params, tokens, pad_lens, self._seed))

@@ -83,6 +83,18 @@ class OllamaBackend:
             text = resp.json()["response"]
             return clean_thinking_tokens(text) if self.clean_output else text
 
+        # requests' JSONDecodeError does NOT subclass json.JSONDecodeError
+        # when simplejson is installed (it is here), so catch both; getattr
+        # keeps test doubles that stub out `requests` working
+        json_errors = (
+            getattr(
+                getattr(requests, "exceptions", None),
+                "JSONDecodeError",
+                json.JSONDecodeError,
+            ),
+            json.JSONDecodeError,
+        )
+
         def transient(e: Exception) -> bool:
             # ConnectionError yes; NOT requests.Timeout (with the 600 s read
             # timeout a hung server would stall ~40 min/prompt across
@@ -95,7 +107,7 @@ class OllamaBackend:
                 status = e.response.status_code if e.response is not None else 0
                 return status >= 500 or status in (408, 429)
             return isinstance(
-                e, (requests.ConnectionError, json.JSONDecodeError, KeyError)
+                e, (requests.ConnectionError, *json_errors, KeyError)
             )
 
         # the reference has no retries anywhere (SURVEY.md §5 "Failure
@@ -107,7 +119,7 @@ class OllamaBackend:
             retryable=(
                 requests.ConnectionError,
                 requests.HTTPError,
-                json.JSONDecodeError,  # requests' JSONDecodeError subclasses it
+                *json_errors,
                 KeyError,
             ),
             should_retry=transient,

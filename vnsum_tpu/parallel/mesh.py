@@ -24,6 +24,8 @@ class MeshAxes:
     data: str = "data"
     model: str = "model"
     seq: str = "seq"
+    fsdp: str = "fsdp"  # stacked-layer (stage) sharding; weights all-gather
+    #                     per layer-scan step, FSDP/ZeRO-3 style
 
 
 AXES = MeshAxes()
@@ -43,6 +45,10 @@ def make_mesh(
     shape = dict(shape or {})
     for ax in (AXES.data, AXES.model, AXES.seq):
         shape.setdefault(ax, 1)
+    # the fsdp axis is opt-in: only materialize it when requested, so
+    # existing (data, model, seq) meshes keep their shape
+    if AXES.fsdp in shape and shape[AXES.fsdp] in (1, None):
+        shape.pop(AXES.fsdp)
     wild = [ax for ax, s in shape.items() if s == -1]
     if len(wild) > 1:
         raise ValueError("at most one mesh axis may be -1")

@@ -15,16 +15,18 @@ from jax.sharding import PartitionSpec as P
 
 from .mesh import AXES
 
-_D, _M = AXES.data, AXES.model
+_D, _M, _F = AXES.data, AXES.model, AXES.fsdp
 
 
 def param_specs(
-    tie_embeddings: bool = True, quantized: bool = False
+    tie_embeddings: bool = True, quantized: bool = False, fsdp: bool = False
 ) -> dict[str, Any]:
     """PartitionSpec pytree matching models.llama param structure.
 
-    Layer leaves carry a leading stacked-layer dim (scanned), hence the
-    leading None in every layer spec.
+    Layer leaves carry a leading stacked-layer dim (scanned); with
+    ``fsdp=True`` that dim is sharded over the `fsdp` mesh axis (ZeRO-3
+    style: each layer-scan step all-gathers just that layer's weights, so
+    per-device parameter + optimizer memory drops by the axis size).
 
     With ``quantized=True`` the tree matches models.quant.quantize_params
     output: each matmul weight becomes ``{"q": <weight spec>, "s": <scale
@@ -32,18 +34,19 @@ def param_specs(
     axes removed (a per-output-channel scale lives on the output axes, so it
     inherits exactly their sharding).
     """
+    L = _F if fsdp else None  # leading stacked-layer dim of every layer leaf
     specs = {
         "embed": P(_M, None),          # vocab-sharded embedding
         "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, _M, None),   # [L, D, nh, hd] — heads sharded
-            "wk": P(None, None, _M, None),
-            "wv": P(None, None, _M, None),
-            "wo": P(None, _M, None, None),   # [L, nh, hd, D]
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, None, _M),     # [L, D, I] — hidden sharded
-            "w_up": P(None, None, _M),
-            "w_down": P(None, _M, None),     # [L, I, D]
+            "attn_norm": P(L, None),
+            "wq": P(L, None, _M, None),      # [L, D, nh, hd] — heads sharded
+            "wk": P(L, None, _M, None),
+            "wv": P(L, None, _M, None),
+            "wo": P(L, _M, None, None),      # [L, nh, hd, D]
+            "mlp_norm": P(L, None),
+            "w_gate": P(L, None, _M),        # [L, D, I] — hidden sharded
+            "w_up": P(L, None, _M),
+            "w_down": P(L, _M, None),        # [L, I, D]
         },
         "final_norm": P(None),
     }
@@ -76,11 +79,14 @@ def batch_spec() -> P:
 
 
 def param_shardings(
-    mesh: Mesh, tie_embeddings: bool = True, quantized: bool = False
+    mesh: Mesh,
+    tie_embeddings: bool = True,
+    quantized: bool = False,
+    fsdp: bool = False,
 ) -> dict[str, Any]:
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(tie_embeddings, quantized),
+        param_specs(tie_embeddings, quantized, fsdp),
         is_leaf=lambda x: isinstance(x, P),
     )
 

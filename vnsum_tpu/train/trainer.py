@@ -59,6 +59,8 @@ class TrainConfig:
     grad_clip: float = 1.0
     remat: bool = True
     context_parallel: bool = False  # ring attention over the seq axis
+    fsdp: bool = False  # shard stacked layers (+ their optimizer state)
+    #                     over the mesh `fsdp` axis, ZeRO-3 style
 
 
 class Trainer:
@@ -85,7 +87,20 @@ class Trainer:
             ),
         )
 
-        p_shardings = param_shardings(mesh, self.cfg.tie_embeddings)
+        if self.tc.fsdp:
+            if AXES.fsdp not in mesh.shape:
+                raise ValueError(
+                    "TrainConfig.fsdp=True needs a mesh with an 'fsdp' axis "
+                    "(make_mesh({'fsdp': N, ...}))"
+                )
+            if self.cfg.n_layers % mesh.shape[AXES.fsdp]:
+                raise ValueError(
+                    f"n_layers={self.cfg.n_layers} not divisible by the "
+                    f"fsdp axis ({mesh.shape[AXES.fsdp]})"
+                )
+        p_shardings = param_shardings(
+            mesh, self.cfg.tie_embeddings, fsdp=self.tc.fsdp
+        )
         if params is None:
             # init directly into the sharded layout: each leaf is produced
             # under jit with its target sharding, so a 2-chip mesh never
@@ -133,7 +148,7 @@ class Trainer:
         """PartitionSpecs for the optax state: any state subtree that has the
         params' exact tree structure (AdamW mu/nu) inherits the param specs;
         every other leaf (counters, empty states) replicates."""
-        specs = param_specs(self.cfg.tie_embeddings)
+        specs = param_specs(self.cfg.tie_embeddings, fsdp=self.tc.fsdp)
         abstract = jax.eval_shape(
             lambda: init_params(jax.random.key(0), self.cfg)
         )
