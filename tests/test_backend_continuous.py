@@ -91,9 +91,11 @@ def test_continuous_single_prompt():
     )
 
 
-def test_continuous_respects_mesh_exclusion():
-    """continuous='auto' must stay off under a mesh (per-row gather would
-    fight the data sharding)."""
+def test_continuous_auto_enabled_under_mesh():
+    """continuous='auto' stays on under a mesh since round 2: compaction
+    halves batches only down to shapes the data axis still divides, and
+    outputs match the single-device engine (see
+    test_backend_engine.test_mesh_continuous_compaction_fires_and_matches)."""
     import jax
 
     from vnsum_tpu.parallel import make_mesh
@@ -105,4 +107,4 @@ def test_continuous_respects_mesh_exclusion():
         model_config=tiny_llama(max_seq_len=128), batch_size=4,
         max_new_tokens=8, mesh=mesh,
     )
-    assert be.continuous is False
+    assert be.continuous is True

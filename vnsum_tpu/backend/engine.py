@@ -64,6 +64,7 @@ class EngineStats:
     generate_seconds: float = 0.0
     batches: int = 0
     compactions: int = 0
+    compacted_batch_sizes: list = field(default_factory=list)
     by_bucket: dict = field(default_factory=dict)
 
     @property
@@ -91,25 +92,23 @@ class TpuBackend:
         continuous: str | bool = "auto",
         segment_tokens: int = 128,
         min_batch: int = 8,
+        interpret: bool = False,
     ) -> None:
         self.cfg = model_config or llama32_3b()
-        # Pallas flash prefill: "auto" enables it on real TPU only (the
-        # kernel needs Mosaic; CPU tests use interpret mode explicitly)
+        self.interpret = bool(interpret)
+        # Pallas flash prefill: "auto" enables it on real TPU (the kernel
+        # needs Mosaic; CPU tests pass interpret=True explicitly). Under a
+        # mesh the kernels run per-shard inside shard_map — batch and heads
+        # are data/model-local, so no cross-chip softmax is needed.
         if flash == "auto":
-            flash = jax.default_backend() == "tpu" and mesh is None
-        elif flash and mesh is not None:
-            raise ValueError(
-                "flash=True is incompatible with a mesh: the Pallas kernels "
-                "run per-chip (no shard_map wiring); under GSPMD they would "
-                "force an all-gather of the stacked KV cache every step"
-            )
+            flash = jax.default_backend() == "tpu"
         self.flash = bool(flash)
         # int8 KV cache halves decode-attention HBM traffic; the in-kernel
         # dequant needs the Pallas path, so "auto" follows flash AND actual
         # kernel support (head_dim lane alignment — e.g. llama32_1b's
         # head_dim=64 can't take the kernels, and the dense fallback would
         # dequantize the whole cache per step)
-        kernels_supported = self.cfg.head_dim % 128 == 0
+        kernels_supported = self.cfg.head_dim % 128 == 0 or self.interpret
         if quantize_kv == "auto":
             quantize_kv = self.flash and kernels_supported
         elif quantize_kv and not (self.flash and kernels_supported):
@@ -135,14 +134,10 @@ class TpuBackend:
         # ragged generation lengths don't pay full-batch decode for the tail.
         # Exact for greedy decoding (each row's stream depends only on its
         # own cache); sampled streams change because the per-step batch
-        # shape changes.
+        # shape changes. Under a mesh, compaction only halves down to batch
+        # shapes that stay divisible by the data axis.
         if continuous == "auto":
-            continuous = mesh is None
-        elif continuous and mesh is not None:
-            raise ValueError(
-                "continuous=True is incompatible with a mesh: per-row "
-                "harvest/compaction gathers fight the data sharding"
-            )
+            continuous = True
         self.continuous = bool(continuous)
         self.segment_tokens = max(segment_tokens, 1)
         self.min_batch = max(min_batch, 1)
@@ -194,6 +189,7 @@ class TpuBackend:
         use_flash, use_flash_decode = self._decode_settings(S, C)
         mesh = self.mesh
         quantize_kv = self.quantize_kv
+        interpret = self.interpret
 
         def prefill_part(params, tokens, pad_lens, seed):
             cache = init_kv_cache(cfg, B, C, quantized=quantize_kv)
@@ -207,19 +203,29 @@ class TpuBackend:
                 cache = jax.lax.with_sharding_constraint(
                     cache,
                     jax.tree.map(
-                        lambda s: NamedSharding(mesh, s), cache_specs(),
+                        lambda s: NamedSharding(mesh, s),
+                        cache_specs(quantized=quantize_kv),
                         is_leaf=lambda x: not isinstance(x, dict),
                     ),
                 )
             positions = prefill_positions(pad_lens, S)
             mask = prefill_attention_mask(pad_lens, S, C)
             prefill_stacked_fn = None
-            if use_flash:
+            if use_flash and mesh is not None:
+                from ..ops.sharded import sharded_flash_prefill
+
+                def prefill_stacked_fn(q, cache, layer_idx):
+                    return sharded_flash_prefill(
+                        mesh, q, cache, layer_idx, pad_lens, cfg.q_per_kv,
+                        interpret=interpret,
+                    )
+            elif use_flash:
                 from ..ops.flash_attention import flash_prefill_attention
 
                 def prefill_stacked_fn(q, cache, layer_idx):
                     return flash_prefill_attention(
-                        q, cache, layer_idx, pad_lens, cfg.q_per_kv
+                        q, cache, layer_idx, pad_lens, cfg.q_per_kv,
+                        interpret=interpret,
                     )
 
             logits, cache = forward(
@@ -255,13 +261,21 @@ class TpuBackend:
                 pos = (S - pad_lens) + t
                 mask_t = decode_attention_mask(pad_lens, S + t, C)
                 stacked_fn = None
-                if use_flash_decode:
+                if use_flash_decode and mesh is not None:
+                    from ..ops.sharded import sharded_flash_decode
+
+                    def stacked_fn(q, cache, layer_idx):
+                        return sharded_flash_decode(
+                            mesh, q, cache, layer_idx, pad_lens, S + t,
+                            cfg.q_per_kv, interpret=interpret,
+                        )
+                elif use_flash_decode:
                     from ..ops.decode_attention import flash_decode_attention
 
                     def stacked_fn(q, cache, layer_idx):
                         return flash_decode_attention(
                             q, cache, layer_idx, pad_lens, S + t,
-                            cfg.q_per_kv,
+                            cfg.q_per_kv, interpret=interpret,
                         )
 
                 logits, cache = forward(
@@ -296,28 +310,34 @@ class TpuBackend:
             )
             return out  # [B, max_new]
 
-        fn = jax.jit(generate)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from ..models.quant import is_quantized
-            from ..parallel.sharding import param_shardings
-
-            ns = lambda spec: NamedSharding(self.mesh, spec)
-            fn = jax.jit(
+            return jax.jit(
                 generate,
-                in_shardings=(
-                    param_shardings(
-                        self.mesh, self.cfg.tie_embeddings,
-                        is_quantized(self.params),
-                    ),
-                    ns(P("data", None)),
-                    ns(P("data")),
-                    None,
-                ),
-                out_shardings=ns(P("data", None)),
+                in_shardings=self._mesh_in_shardings(),
+                out_shardings=NamedSharding(self.mesh, P("data", None)),
             )
-        return fn
+        return jax.jit(generate)
+
+    def _mesh_in_shardings(self):
+        """in_shardings for (params, tokens, pad_lens, seed) — shared by the
+        one-shot and continuous prefill builders so the two paths cannot
+        compile against different input layouts."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..models.quant import is_quantized
+        from ..parallel.sharding import param_shardings
+
+        ns = lambda spec: NamedSharding(self.mesh, spec)
+        return (
+            param_shardings(
+                self.mesh, self.cfg.tie_embeddings, is_quantized(self.params)
+            ),
+            ns(P("data", None)),
+            ns(P("data")),
+            None,
+        )
 
     def _get_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig):
         key = (B, S, max_new, gen)
@@ -334,6 +354,8 @@ class TpuBackend:
         use_flash = self.flash
         use_flash_decode = False
         if use_flash:
+            if self.interpret:  # interpret mode has no lane-alignment limits
+                return True, True
             from ..ops.decode_attention import supports_decode
             from ..ops.flash_attention import supports_flash
 
@@ -348,6 +370,8 @@ class TpuBackend:
             first, cache, done0, key = prefill_part(params, tokens, pad_lens, seed)
             return first, cache, done0, jax.random.key_data(key)
 
+        if self.mesh is not None:
+            return jax.jit(prefill, in_shardings=self._mesh_in_shardings())
         return jax.jit(prefill)
 
     def _make_segment_fn(self, B: int, S: int, max_new: int, gen):
@@ -436,9 +460,16 @@ class TpuBackend:
             if t_h >= max_new or not active:
                 break
 
-            # compact when the survivors fit a half-size program
+            # compact when the survivors fit a half-size program (under a
+            # mesh, only down to batches the data axis still divides)
+            data_size = (
+                self.mesh.shape.get("data", 1) if self.mesh is not None else 1
+            )
             B_new = B
-            while B_new // 2 >= max(len(active), self.min_batch, 1):
+            while (
+                B_new // 2 >= max(len(active), self.min_batch, 1)
+                and (B_new // 2) % data_size == 0
+            ):
                 B_new //= 2
             if B_new < B:
                 out_h = np.asarray(out)
@@ -455,6 +486,7 @@ class TpuBackend:
                 rows = [rows[r] if r in active else None for r in idx]
                 B = B_new
                 self.stats.compactions += 1
+                self.stats.compacted_batch_sizes.append(B_new)
                 logger.info(
                     "compacted decode batch to B=%d (%d live, t=%d)",
                     B, len(active), t_h,

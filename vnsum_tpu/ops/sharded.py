@@ -1,0 +1,79 @@
+"""shard_map wrappers that keep the Pallas attention kernels under a mesh.
+
+Without these, a meshed engine had to fall back to the dense XLA attention
+path (whose per-step whole-cache copies are exactly what the kernels remove
+— see ops/decode_attention.py). The wrapping is collective-free: batch rows
+live on the `data` axis and heads on the `model` axis, so every (row, head)
+softmax is complete within one shard — each chip just runs the same kernel
+on its local q/cache blocks. GSPMD continues to partition the rest of the
+forward around these calls.
+
+The reference has no analog (its only "distribution" is HTTP to Ollama,
+SURVEY.md §2.2); this is the scaling-book recipe: pick a mesh, keep the hot
+kernel local, let the compiler move everything else.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+from jax import shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import AXES
+from ..parallel.sharding import cache_specs
+from .decode_attention import flash_decode_attention
+from .flash_attention import flash_prefill_attention
+
+_Q_SPEC = P(AXES.data, None, AXES.model, None)  # [B, S|1, H, hd]
+
+
+def _cache_specs(cache: dict) -> dict:
+    return cache_specs(quantized="ks" in cache)
+
+
+def sharded_flash_prefill(
+    mesh: Mesh,
+    q,
+    cache: dict,
+    layer_idx,
+    pad_lens,
+    q_per_kv: int,
+    *,
+    interpret: bool = False,
+):
+    """flash_prefill_attention with q/cache sharded over (data, model)."""
+    fn = shard_map(
+        partial(
+            flash_prefill_attention, q_per_kv=q_per_kv, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(_Q_SPEC, _cache_specs(cache), P(), P(AXES.data)),
+        out_specs=_Q_SPEC,
+        check_vma=False,
+    )
+    return fn(q, cache, layer_idx, pad_lens)
+
+
+def sharded_flash_decode(
+    mesh: Mesh,
+    q,
+    cache: dict,
+    layer_idx,
+    pad_lens,
+    fill,
+    q_per_kv: int,
+    *,
+    interpret: bool = False,
+):
+    """flash_decode_attention with q/cache sharded over (data, model)."""
+    fn = shard_map(
+        partial(
+            flash_decode_attention, q_per_kv=q_per_kv, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(_Q_SPEC, _cache_specs(cache), P(), P(AXES.data), P()),
+        out_specs=_Q_SPEC,
+        check_vma=False,
+    )
+    return fn(q, cache, layer_idx, pad_lens, fill)

@@ -68,9 +68,19 @@ def param_specs(
     return specs
 
 
-def cache_specs() -> dict[str, Any]:
-    """KV cache [L, B, kv_heads, C, hd]: batch over data, heads over model."""
-    return {"k": P(None, _D, _M, None, None), "v": P(None, _D, _M, None, None)}
+def cache_specs(quantized: bool = False) -> dict[str, Any]:
+    """KV cache [L, B, kv_heads, C, hd]: batch over data, heads over model.
+
+    With ``quantized=True`` adds the int8-cache per-(token, head) scale
+    planes [L, B, kv_heads, C], which shard exactly like their cache dims.
+    """
+    kv = P(None, _D, _M, None, None)
+    specs: dict[str, Any] = {"k": kv, "v": kv}
+    if quantized:
+        scale = P(None, _D, _M, None)
+        specs["ks"] = scale
+        specs["vs"] = scale
+    return specs
 
 
 def batch_spec() -> P:
