@@ -1,0 +1,152 @@
+"""Produce the end-to-end quality-parity artifact.
+
+Exercises the COMPLETE real-weight chain the quality gate needs
+(VERDICT r1 'what's missing' #1): HF checkpoint on disk (config.json +
+model.safetensors + trained BPE tokenizer) → models.convert →
+TpuBackend(HF tokenizer) → mapreduce strategy → ROUGE/BERTScore/semsim →
+structured results JSON. With no pretrained weights on an air-gapped host,
+the checkpoint is a tiny real-format transformers Llama LM-trained on a
+synthetic VN corpus (models.fixtures), so greedy decoding emits sane
+Vietnamese and ROUGE is meaningful.
+
+For the reference's actual gate (mapreduce + Llama-3.2-3B on VN-LongSum,
+ROUGE-L ≈ 0.3053 — evaluation_results/first_dataset/mapreduce/
+llama3_2_3b_results.json), run the same command with the real checkout:
+
+    vnsum-pipeline --approach mapreduce --backend tpu \
+        --weights-dir /path/to/Llama-3.2-3B \
+        --docs-dir data_1/doc --summary-dir data_1/summary
+
+Usage: python scripts/make_parity_artifact.py [--out artifacts/parity_e2e_tiny.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(REPO / "artifacts/parity_e2e_tiny.json"))
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--docs", type=int, default=6)
+    ap.add_argument("--tokens-per-doc", type=int, default=1500)
+    ap.add_argument("--train-steps", type=int, default=300)
+    args = ap.parse_args()
+
+    from vnsum_tpu.core.config import PipelineConfig
+    from vnsum_tpu.data.synthesize import synthesize_corpus
+    from vnsum_tpu.models.fixtures import make_tiny_hf_checkpoint
+    from vnsum_tpu.pipeline.runner import PipelineRunner
+
+    work = Path(args.workdir or tempfile.mkdtemp(prefix="parity_"))
+    corpus_dir = work / "corpus"
+    ckpt_dir = work / "ckpt"
+
+    t0 = time.time()
+    corpus_stats = synthesize_corpus(
+        corpus_dir, n_docs=args.docs, tokens_per_doc=args.tokens_per_doc,
+        summary_tokens=100, seed=0,
+    )
+    docs = [
+        p.read_text(encoding="utf-8")
+        for p in sorted((corpus_dir / "doc").glob("*.txt"))
+    ]
+    ckpt_info = make_tiny_hf_checkpoint(
+        ckpt_dir, docs, vocab_size=1024, train_steps=args.train_steps,
+    )
+
+    cfg = PipelineConfig(
+        approach="mapreduce",
+        models=["tiny-vn-parity"],
+        backend="tpu",
+        weights_dir=str(ckpt_dir),
+        docs_dir=str(corpus_dir / "doc"),
+        summary_dir=str(corpus_dir / "summary"),
+        generated_summaries_dir=str(work / "gen"),
+        results_dir=str(work / "results"),
+        logs_dir=str(work / "logs"),
+        chunk_size=400,
+        chunk_overlap=40,
+        token_max=300,
+        max_new_tokens=96,
+        batch_size=8,
+    )
+    runner = PipelineRunner(cfg)
+    results = runner.run()
+
+    model = cfg.models[0]
+    evaluation = results.evaluation.get(model, {})
+    summarization = results.summarization.get(model, {})
+    samples = sorted(runner._output_dir(model).glob("*.txt"))
+    if not samples:
+        raise RuntimeError(
+            f"no summaries generated; summarization record: {summarization}"
+        )
+
+    artifact = {
+        "what": (
+            "end-to-end real-weight parity chain: HF safetensors checkpoint "
+            "-> models.convert -> TpuBackend(HF BPE tokenizer) -> mapreduce "
+            "-> ROUGE; tiny real-format transformers Llama LM-trained on a "
+            "synthetic VN corpus (no pretrained weights on this host)"
+        ),
+        "reference_gate": {
+            "note": (
+                "reference quality gate is mapreduce + Llama-3.2-3B on "
+                "VN-LongSum, ROUGE-L ~= 0.3053; run the runbook_command "
+                "with that checkpoint to reproduce it on this framework"
+            ),
+            "runbook_command": (
+                "vnsum-pipeline --approach mapreduce --backend tpu "
+                "--weights-dir /path/to/Llama-3.2-3B "
+                "--docs-dir data_1/doc --summary-dir data_1/summary"
+            ),
+        },
+        "backend": "tpu",
+        "jax_devices": _devices(),
+        "corpus": {
+            "docs": corpus_stats["documents"]["total_files"],
+            "avg_doc_tokens": corpus_stats["documents"]["avg_tokens_per_file"],
+            "avg_summary_tokens": corpus_stats["summaries"]["avg_tokens_per_file"],
+        },
+        "checkpoint": ckpt_info,
+        "summarization": {
+            k: summarization.get(k)
+            for k in ("successful", "failed", "total_chunks", "total_time")
+        },
+        "evaluation": evaluation,
+        "sample_generated_summary": samples[0].read_text(encoding="utf-8")[:500],
+        "wall_seconds": round(time.time() - t0, 1),
+        "embedding_metrics_note": (
+            "bert/semsim computed with the on-device encoder; see "
+            "models/encoder.py for its weight provenance"
+        ),
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(
+        json.dumps(artifact, indent=1, ensure_ascii=False), encoding="utf-8"
+    )
+    print(json.dumps({
+        "rougeL": evaluation.get("rouge_scores", {}).get("rougeL_f1"),
+        "out": str(out),
+        "wall_seconds": artifact["wall_seconds"],
+    }))
+
+
+def _devices() -> list[str]:
+    import jax
+
+    return [str(d) for d in jax.devices()]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Corpus synthesizer: VN-LongSum-shaped docs/summaries/tree/metadata
+(ref metadata/doc_metadata.json shape; tree format
+runners/run_summarization_ollama_mapreduce_hierarchical.py:202-239)."""
+import json
+
+from vnsum_tpu.data.synthesize import synthesize_corpus
+from vnsum_tpu.text import DocumentTree
+from vnsum_tpu.text.tokenizer import whitespace_token_count
+
+
+def test_corpus_layout_and_stats(tmp_path):
+    stats = synthesize_corpus(
+        tmp_path, n_docs=4, tokens_per_doc=600, summary_tokens=60, seed=1
+    )
+    docs = sorted((tmp_path / "doc").glob("*.txt"))
+    sums = sorted((tmp_path / "summary").glob("*.txt"))
+    assert len(docs) == len(sums) == 4
+    assert docs[0].name == sums[0].name  # paired by filename
+    assert stats["documents"]["total_files"] == 4
+    # ragged but near target
+    for row in stats["documents"]["files"]:
+        assert 200 < row["tokens"] < 1200
+    for row in stats["summaries"]["files"]:
+        assert row["tokens"] <= 75
+    # Vietnamese diacritics present
+    text = docs[0].read_text(encoding="utf-8")
+    assert any(ch in text for ch in "ếạảịộơư")
+    meta = json.loads(
+        (tmp_path / "metadata" / "doc_metadata.json").read_text
+        (encoding="utf-8")
+    )
+    assert meta["total_tokens"] == stats["documents"]["total_tokens"]
+
+
+def test_tree_json_loads_and_covers_all_docs(tmp_path):
+    synthesize_corpus(tmp_path, n_docs=3, tokens_per_doc=500, seed=2)
+    tree = DocumentTree.load(tmp_path / "document_tree.json")
+    assert len(tree) == 3
+    node = tree.get("doc_000.txt")
+    assert node["type"] == "Document"
+    headers = node["children"]
+    assert headers and all(h["type"] == "Header" for h in headers)
+    paragraphs = [p for h in headers for p in h["children"]]
+    assert paragraphs and all(p["type"] == "Paragraph" for p in paragraphs)
+    # tree paragraphs reconstruct the doc body
+    doc_text = (tmp_path / "doc" / "doc_000.txt").read_text(encoding="utf-8")
+    for p in paragraphs[:3]:
+        assert p["text"] in doc_text
+
+
+def test_deterministic_by_seed(tmp_path):
+    a = synthesize_corpus(tmp_path / "a", n_docs=2, tokens_per_doc=400, seed=7)
+    b = synthesize_corpus(tmp_path / "b", n_docs=2, tokens_per_doc=400, seed=7)
+    assert a == b
+    ta = (tmp_path / "a/doc/doc_000.txt").read_text(encoding="utf-8")
+    tb = (tmp_path / "b/doc/doc_000.txt").read_text(encoding="utf-8")
+    assert ta == tb
+
+
+def test_summary_is_extractive_of_doc_leads(tmp_path):
+    synthesize_corpus(tmp_path, n_docs=1, tokens_per_doc=500, seed=3)
+    doc = (tmp_path / "doc/doc_000.txt").read_text(encoding="utf-8")
+    summary = (tmp_path / "summary/doc_000.txt").read_text(encoding="utf-8")
+    assert whitespace_token_count(summary) < whitespace_token_count(doc)
+    # each summary sentence except the canned closer comes from the doc
+    sentences = [s.strip() + "." for s in summary.split(".") if s.strip()]
+    in_doc = sum(s in doc for s in sentences)
+    assert in_doc >= len(sentences) - 1

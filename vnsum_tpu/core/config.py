@@ -107,6 +107,10 @@ class PipelineConfig:
     tokenizer: str = "byte"  # byte | hf:<name-or-path>
     mesh_shape: dict[str, int] = field(default_factory=dict)
     dtype: str = "bfloat16"
+    # local HF checkpoint dir (config.json + *.safetensors + tokenizer files)
+    # for the tpu backend: weights are converted via models.convert and the
+    # checkpoint's tokenizer is used unless `tokenizer` is explicitly hf:<..>
+    weights_dir: str | None = None
 
     evaluation: EvalConfig = field(default_factory=EvalConfig)
 
@@ -120,6 +124,12 @@ class PipelineConfig:
         if self.iterative_chunk_overlap >= self.iterative_chunk_size:
             raise ValueError(
                 "iterative_chunk_overlap must be smaller than iterative_chunk_size"
+            )
+        if self.weights_dir and len(self.models) > 1:
+            raise ValueError(
+                "weights_dir points at ONE checkpoint; with multiple models "
+                "every entry would silently run the same weights — run one "
+                "model per weights_dir"
             )
 
     def to_dict(self) -> dict:

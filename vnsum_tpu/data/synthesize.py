@@ -1,0 +1,264 @@
+"""Synthetic VN-LongSum-shaped corpus generator.
+
+The reference's datasets live on Google Drive (README.md:25-26) and only
+their metadata is committed (metadata/doc_metadata.json: 150 docs, avg
+54,566 tokens/doc; summary_metadata.json: avg 714 tokens). On an air-gapped
+TPU host the pipeline still needs a corpus with the same *shape* — long
+multi-section Vietnamese documents with reference summaries and a document
+structure tree — for end-to-end runs, benchmarks, and the hierarchical
+strategy. This module builds one deterministically.
+
+Documents are assembled from a Vietnamese sentence grammar (topic subjects ×
+predicates × numeric variations, full diacritics) into titled sections, so
+they are ragged, non-repetitive enough to exercise tokenizers/ROUGE, and
+carry real structure for the tree JSON ({type, text, children} — reference
+runners/run_summarization_ollama_mapreduce_hierarchical.py:202-239).
+Reference summaries take each section's lead sentences, mirroring how the
+real summaries compress per-topic content.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+from pathlib import Path
+
+from ..text.tokenizer import whitespace_token_count
+
+_TOPICS = [
+    ("kinh tế", [
+        "nền kinh tế Việt Nam", "ngành xuất khẩu thủy sản", "thị trường bất động sản",
+        "khu vực doanh nghiệp nhỏ và vừa", "ngành du lịch trong nước",
+    ]),
+    ("môi trường", [
+        "chất lượng không khí tại các đô thị lớn", "hệ sinh thái rừng ngập mặn",
+        "nguồn nước sông Mê Kông", "công tác xử lý rác thải nhựa",
+        "đa dạng sinh học ở Tây Nguyên",
+    ]),
+    ("giáo dục", [
+        "chương trình giáo dục phổ thông mới", "hệ thống trường nghề",
+        "việc dạy và học ngoại ngữ", "chuyển đổi số trong nhà trường",
+        "chính sách học phí đại học",
+    ]),
+    ("y tế", [
+        "mạng lưới y tế cơ sở", "công tác tiêm chủng mở rộng",
+        "tình trạng quá tải bệnh viện tuyến trung ương", "bảo hiểm y tế toàn dân",
+        "nguồn nhân lực ngành điều dưỡng",
+    ]),
+    ("pháp luật", [
+        "dự thảo luật đất đai sửa đổi", "quy định về an toàn giao thông",
+        "chính sách thuế thu nhập cá nhân", "công tác phòng chống tham nhũng",
+        "thủ tục hành chính công trực tuyến",
+    ]),
+]
+
+_PREDICATES = [
+    "đã có những chuyển biến tích cực trong {period}",
+    "đang đối mặt với nhiều thách thức lớn về nguồn lực",
+    "được dự báo sẽ tăng trưởng khoảng {pct} phần trăm trong năm tới",
+    "cần thêm các giải pháp đồng bộ từ trung ương đến địa phương",
+    "thu hút sự quan tâm đặc biệt của dư luận xã hội",
+    "ghi nhận mức đầu tư hơn {num} tỷ đồng trong {period}",
+    "chịu ảnh hưởng rõ rệt từ biến động kinh tế toàn cầu",
+    "đạt kết quả vượt chỉ tiêu đề ra với {pct} phần trăm kế hoạch",
+    "còn tồn tại không ít hạn chế cần khắc phục sớm",
+    "sẽ được rà soát toàn diện theo chỉ đạo của Chính phủ",
+    "đóng vai trò then chốt trong chiến lược phát triển bền vững",
+    "tiếp tục là điểm sáng được các chuyên gia đánh giá cao",
+]
+
+_PERIODS = [
+    "quý một", "quý hai", "sáu tháng đầu năm", "giai đoạn vừa qua",
+    "năm năm gần đây", "thập kỷ qua",
+]
+
+_CONNECTORS = [
+    "Bên cạnh đó,", "Theo báo cáo mới nhất,", "Trong khi đó,",
+    "Đáng chú ý,", "Về lâu dài,", "Tuy nhiên,", "Trên thực tế,",
+    "Theo các chuyên gia,",
+]
+
+
+def _sentence(rng: random.Random, subjects: list[str]) -> str:
+    subj = rng.choice(subjects)
+    pred = rng.choice(_PREDICATES).format(
+        pct=rng.randint(2, 95), num=rng.randint(10, 900),
+        period=rng.choice(_PERIODS),
+    )
+    lead = rng.choice(_CONNECTORS) + " " if rng.random() < 0.4 else ""
+    s = f"{lead}{subj} {pred}."
+    return s[0].upper() + s[1:]
+
+
+def _section(
+    rng: random.Random, topic: str, subjects: list[str], target_tokens: int
+) -> tuple[str, list[str], str]:
+    """Returns (header, paragraphs, lead_sentence_for_summary)."""
+    header = f"Phần về {topic} ({rng.choice(_PERIODS)})"
+    paragraphs: list[str] = []
+    lead = _sentence(rng, subjects)
+    tokens = whitespace_token_count(lead)
+    current = [lead]
+    while tokens < target_tokens:
+        s = _sentence(rng, subjects)
+        tokens += whitespace_token_count(s)
+        current.append(s)
+        if len(current) >= rng.randint(4, 8):
+            paragraphs.append(" ".join(current))
+            current = []
+    if current:
+        paragraphs.append(" ".join(current))
+    return header, paragraphs, lead
+
+
+def synthesize_corpus(
+    out_dir: str | Path,
+    n_docs: int = 10,
+    tokens_per_doc: int = 2000,
+    summary_tokens: int = 120,
+    seed: int = 0,
+    ragged: float = 0.5,
+) -> dict:
+    """Write doc/, summary/, document_tree.json, metadata/ under ``out_dir``.
+
+    ``tokens_per_doc`` is a whitespace-token target; actual lengths are
+    ragged by ±``ragged``/2 (VN-LongSum docs vary widely around their 54k
+    mean). Returns corpus stats (doc/summary token totals).
+    """
+    out = Path(out_dir)
+    (out / "doc").mkdir(parents=True, exist_ok=True)
+    (out / "summary").mkdir(parents=True, exist_ok=True)
+    (out / "metadata").mkdir(parents=True, exist_ok=True)
+    rng = random.Random(seed)
+
+    tree_entries = []
+    doc_meta, sum_meta = [], []
+    for i in range(n_docs):
+        name = f"doc_{i:03d}.txt"
+        target = max(
+            80, int(tokens_per_doc * (1 + ragged * (rng.random() - 0.5)))
+        )
+        n_sections = max(2, min(8, target // 400 + 2))
+        topics = rng.sample(_TOPICS, k=min(n_sections, len(_TOPICS)))
+        while len(topics) < n_sections:
+            topics.append(rng.choice(_TOPICS))
+
+        title = f"Báo cáo tổng hợp số {i + 1} về tình hình {topics[0][0]} và {topics[1][0]}"
+        sections, leads = [], []
+        for topic, subjects in topics:
+            header, paragraphs, lead = _section(
+                rng, topic, subjects, target // n_sections
+            )
+            sections.append((header, paragraphs))
+            leads.append(lead)
+
+        body = [title, ""]
+        for header, paragraphs in sections:
+            body.append(header)
+            body.extend(paragraphs)
+            body.append("")
+        doc_text = "\n\n".join(body).strip()
+
+        # summary: section leads + a closing sentence, clipped near target
+        closing = (
+            "Nhìn chung, báo cáo cho thấy các lĩnh vực trên cần được theo dõi "
+            "sát sao và điều phối chặt chẽ trong thời gian tới."
+        )
+        summary_parts: list[str] = []
+        tokens = 0
+        for lead in leads + [closing]:
+            t = whitespace_token_count(lead)
+            if summary_parts and tokens + t > summary_tokens:
+                break
+            summary_parts.append(lead)
+            tokens += t
+        summary_text = " ".join(summary_parts)
+
+        (out / "doc" / name).write_text(doc_text, encoding="utf-8")
+        (out / "summary" / name).write_text(summary_text, encoding="utf-8")
+
+        tree_entries.append({
+            "filename": name,
+            "tree": {
+                "type": "Document",
+                "text": title,
+                "children": [
+                    {
+                        "type": "Header",
+                        "text": header,
+                        "children": [
+                            {"type": "Paragraph", "text": p, "children": []}
+                            for p in paragraphs
+                        ],
+                    }
+                    for header, paragraphs in sections
+                ],
+            },
+        })
+        doc_meta.append({
+            "filename": name,
+            "tokens": whitespace_token_count(doc_text),
+            "chars": len(doc_text),
+        })
+        sum_meta.append({
+            "filename": name,
+            "tokens": whitespace_token_count(summary_text),
+            "chars": len(summary_text),
+        })
+
+    (out / "document_tree.json").write_text(
+        json.dumps(tree_entries, ensure_ascii=False), encoding="utf-8"
+    )
+
+    def _meta(rows: list[dict]) -> dict:
+        total = sum(r["tokens"] for r in rows)
+        return {
+            "total_files": len(rows),
+            "total_tokens": total,
+            "avg_tokens_per_file": total / len(rows) if rows else 0.0,
+            "files": rows,
+        }
+
+    stats = {"documents": _meta(doc_meta), "summaries": _meta(sum_meta)}
+    (out / "metadata" / "doc_metadata.json").write_text(
+        json.dumps(stats["documents"], ensure_ascii=False, indent=1),
+        encoding="utf-8",
+    )
+    (out / "metadata" / "summary_metadata.json").write_text(
+        json.dumps(stats["summaries"], ensure_ascii=False, indent=1),
+        encoding="utf-8",
+    )
+    return stats
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="Synthesize a VN-LongSum-shaped corpus "
+        "(docs + summaries + tree JSON + metadata)"
+    )
+    ap.add_argument("--out", required=True, help="output corpus dir")
+    ap.add_argument("--docs", type=int, default=150)
+    ap.add_argument(
+        "--tokens-per-doc", type=int, default=54_000,
+        help="whitespace-token target per doc (VN-LongSum avg 54,566)",
+    )
+    ap.add_argument(
+        "--summary-tokens", type=int, default=714,
+        help="reference-summary token target (VN-LongSum avg 714)",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    stats = synthesize_corpus(
+        args.out, args.docs, args.tokens_per_doc, args.summary_tokens,
+        args.seed,
+    )
+    print(json.dumps({
+        "docs": stats["documents"]["total_files"],
+        "doc_tokens": stats["documents"]["total_tokens"],
+        "avg_doc_tokens": round(stats["documents"]["avg_tokens_per_file"]),
+        "avg_summary_tokens": round(stats["summaries"]["avg_tokens_per_file"]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Real-format tiny HF Llama checkpoints for offline parity runs.
+
+The quality-parity chain (safetensors → convert → TpuBackend(HF tokenizer)
+→ strategy → ROUGE; reference quality gate
+evaluation_results/first_dataset/mapreduce/llama3_2_3b_results.json) needs a
+real HF checkpoint to exercise. Air-gapped hosts have no pretrained weights,
+so this module builds one: a genuine ``transformers.LlamaForCausalLM``
+saved via ``save_pretrained`` (config.json + model.safetensors) with a
+genuine BPE tokenizer *trained on the target corpus* (tokenizer.json via the
+``tokenizers`` library) — every file format identical to a hub checkpoint,
+just small. ``train_steps > 0`` additionally fits the LM on the corpus
+(torch CPU) so greedy decoding emits corpus-like Vietnamese instead of
+random bytes.
+
+For a real pretrained model (e.g. Llama-3.2-3B) none of this is needed:
+point ``--weights-dir`` at its checkout (see pipeline.cli).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, Sequence
+
+_BOS, _EOS, _PAD = "<|bos|>", "<|eos|>", "<|pad|>"
+
+
+def train_bpe_tokenizer(corpus: Iterable[str], vocab_size: int = 1024):
+    """Train a byte-level BPE tokenizer; returns PreTrainedTokenizerFast."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=True)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=[_PAD, _BOS, _EOS],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(corpus, trainer)
+    return PreTrainedTokenizerFast(
+        tokenizer_object=tok, bos_token=_BOS, eos_token=_EOS, pad_token=_PAD
+    )
+
+
+def make_tiny_hf_checkpoint(
+    out_dir: str | Path,
+    corpus: Sequence[str],
+    vocab_size: int = 1024,
+    dim: int = 128,
+    n_layers: int = 2,
+    n_heads: int = 4,
+    n_kv_heads: int = 2,
+    intermediate: int = 256,
+    max_seq_len: int = 1024,
+    seed: int = 0,
+    train_steps: int = 0,
+    train_seq_len: int = 128,
+    train_batch: int = 16,
+    lr: float = 3e-3,
+) -> dict:
+    """Build (and optionally train) a tiny HF Llama checkpoint at out_dir.
+
+    Returns {"loss_first", "loss_last", "vocab_size"} for logging.
+    """
+    import torch
+    import transformers
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    hf_tok = train_bpe_tokenizer(corpus, vocab_size=vocab_size)
+    vocab = len(hf_tok)
+
+    torch.manual_seed(seed)
+    cfg = transformers.LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=dim,
+        num_hidden_layers=n_layers,
+        num_attention_heads=n_heads,
+        num_key_value_heads=n_kv_heads,
+        intermediate_size=intermediate,
+        max_position_embeddings=max_seq_len,
+        rms_norm_eps=1e-5,
+        rope_theta=10_000.0,
+        tie_word_embeddings=False,
+        bos_token_id=hf_tok.bos_token_id,
+        eos_token_id=hf_tok.eos_token_id,
+        pad_token_id=hf_tok.pad_token_id,
+    )
+    model = transformers.LlamaForCausalLM(cfg)
+
+    loss_first = loss_last = None
+    if train_steps > 0:
+        ids: list[int] = []
+        for text in corpus:
+            ids.extend(hf_tok.encode(text))
+            ids.append(hf_tok.eos_token_id)
+        n_windows = max(1, len(ids) // train_seq_len)
+        data = torch.tensor(
+            ids[: n_windows * train_seq_len], dtype=torch.long
+        ).view(n_windows, train_seq_len)
+
+        model.train()
+        opt = torch.optim.AdamW(model.parameters(), lr=lr)
+        gen = torch.Generator().manual_seed(seed)
+        for step in range(train_steps):
+            rows = torch.randint(
+                0, data.shape[0], (min(train_batch, data.shape[0]),),
+                generator=gen,
+            )
+            batch = data[rows]
+            loss = model(input_ids=batch, labels=batch).loss
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+            if step == 0:
+                loss_first = float(loss.detach())
+            loss_last = float(loss.detach())
+        model.eval()
+
+    model.save_pretrained(out, safe_serialization=True)
+    hf_tok.save_pretrained(out)
+    return {
+        "loss_first": loss_first,
+        "loss_last": loss_last,
+        "vocab_size": vocab,
+    }

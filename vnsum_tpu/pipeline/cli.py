@@ -35,6 +35,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--mesh", default="", help='device mesh, e.g. "data=2,model=4"'
     )
+    p.add_argument(
+        "--weights-dir", default=None,
+        help="local HF checkpoint dir for the tpu backend (config.json + "
+        "safetensors + tokenizer); e.g. a Llama-3.2-3B checkout. Converted "
+        "via models.convert; the checkpoint's tokenizer is used.",
+    )
+    p.add_argument(
+        "--chunk-size", type=int, default=None,
+        help="override the approach-default chunk size (tokens)",
+    )
+    p.add_argument(
+        "--token-max", type=int, default=None,
+        help="override the approach-default collapse budget (tokens)",
+    )
+    p.add_argument(
+        "--max-new-tokens", type=int, default=None,
+        help="override the approach-default generation budget",
+    )
     return p
 
 
@@ -45,8 +63,22 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         for part in args.mesh.split(","):
             k, v = part.split("=")
             mesh_shape[k.strip()] = int(v)
+    for key in ("chunk_size", "token_max", "max_new_tokens"):
+        val = getattr(args, key)
+        if val is not None:
+            overrides[key] = val
+    if args.chunk_size is not None:
+        # keep overlap a small fraction of the chunk (ref default is
+        # 200/12000); an overlap near chunk_size would shrink the splitter
+        # stride to almost nothing
+        overrides["chunk_overlap"] = min(
+            overrides.get("chunk_overlap", 200), max(0, args.chunk_size // 10)
+        )
+        overrides["iterative_chunk_size"] = args.chunk_size
+        overrides["iterative_chunk_overlap"] = overrides["chunk_overlap"]
     cfg = PipelineConfig(
         approach=args.approach,
+        weights_dir=args.weights_dir,
         models=list(args.models),
         backend=args.backend,
         ollama_url=args.ollama_url,

@@ -79,6 +79,36 @@ class PipelineRunner:
                 max_new_tokens=cfg.max_new_tokens,
             )
         if cfg.backend == "tpu":
+            mesh = None
+            if cfg.mesh_shape:
+                from ..parallel import make_mesh
+
+                mesh = make_mesh(dict(cfg.mesh_shape))
+            if cfg.weights_dir:
+                # real checkpoint: convert safetensors + use its tokenizer
+                # (quality-parity chain; reference loads HF checkpoints at
+                # runners/run_summarization.py:54-62)
+                import jax.numpy as jnp
+
+                from ..models.convert import load_hf_checkpoint
+
+                model_cfg, params = load_hf_checkpoint(
+                    cfg.weights_dir, dtype=getattr(jnp, cfg.dtype)
+                )
+                tokenizer = (
+                    cfg.tokenizer
+                    if cfg.tokenizer.startswith("hf:")
+                    else f"hf:{cfg.weights_dir}"
+                )
+                return get_backend(
+                    "tpu",
+                    model_config=model_cfg,
+                    params=params,
+                    tokenizer=tokenizer,
+                    mesh=mesh,
+                    batch_size=cfg.batch_size,
+                    max_new_tokens=cfg.max_new_tokens,
+                )
             from ..models import MODEL_REGISTRY
 
             if model not in MODEL_REGISTRY:
@@ -86,11 +116,6 @@ class PipelineRunner:
                     f"unknown model {model!r} for tpu backend; "
                     f"have {sorted(MODEL_REGISTRY)}"
                 )
-            mesh = None
-            if cfg.mesh_shape:
-                from ..parallel import make_mesh
-
-                mesh = make_mesh(dict(cfg.mesh_shape))
             return get_backend(
                 "tpu",
                 model_config=MODEL_REGISTRY[model](),
