@@ -108,3 +108,16 @@ def test_continuous_auto_enabled_under_mesh():
         max_new_tokens=8, mesh=mesh,
     )
     assert be.continuous is True
+
+
+def test_sampling_takes_oneshot_path():
+    """temperature>0 must bypass continuous scheduling: compaction reshapes
+    the batch mid-stream, which would silently change sampled outputs vs the
+    one-shot program (ADVICE r1)."""
+    be = make_backend(True, segment_tokens=4, min_batch=1)
+    be.generate(
+        PROMPTS, config=GenerationConfig(temperature=0.8, max_new_tokens=24)
+    )
+    assert not be._seg_fns  # no segmented programs were ever built
+    be.generate(PROMPTS)  # greedy still uses them
+    assert be._seg_fns

@@ -133,3 +133,44 @@ def test_pipeline_persistent_fault_contained(workspace, monkeypatch):
     rec = results.summarization["m"]
     assert rec["failed"] == 3 and rec["successful"] == 0
     assert all(d["status"] == "failed" for d in rec["processing_details"])
+
+
+def test_retrying_backend_fails_fast_on_permanent_error(monkeypatch):
+    """ValueError etc. are programming/input errors — no backoff retries
+    (ADVICE r1: mirror the pipeline's PERMANENT_ERRORS fail-fast filter)."""
+    calls = []
+
+    class Bad:
+        name = "bad"
+
+        def generate(self, prompts, **kw):
+            calls.append(1)
+            raise ValueError("bad config")
+
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    be = RetryingBackend(Bad(), max_retries=3, backoff=0)
+    with pytest.raises(ValueError):
+        be.generate(["x"])
+    assert len(calls) == 1
+
+
+def test_retrying_backend_retries_json_decode_error(monkeypatch):
+    """json.JSONDecodeError subclasses ValueError but is a garbled-body
+    transient — it must be retried, not fail-fasted."""
+    import json
+
+    calls = []
+
+    class Flaky:
+        name = "flaky"
+
+        def generate(self, prompts, **kw):
+            calls.append(1)
+            if len(calls) == 1:
+                raise json.JSONDecodeError("truncated", "{", 1)
+            return ["ok"]
+
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    be = RetryingBackend(Flaky(), max_retries=2, backoff=0)
+    assert be.generate(["x"]) == ["ok"]
+    assert len(calls) == 2

@@ -195,3 +195,18 @@ def test_chunk_clamp_75_percent():
     fb = FakeBackend()
     st = HierarchicalStrategy(fb, chunk_size=999999, max_context=1000)
     assert st.chunk_size == 750
+
+
+def test_llm_calls_are_true_per_document():
+    """VERDICT r1 #8: llm_calls must be the document's own call count, not
+    the batch total smeared onto every result."""
+    small, big = make_doc(1, 5), make_doc(30, 30)
+    fb = FakeBackend(summary_words=10)
+    st = MapReduceStrategy(fb, word_splitter(), token_max=1000)
+    r_small, r_big = st.summarize_batch([small, big])
+    # small doc: 1 map + 1 final reduce; big doc: many maps + final
+    assert r_small.llm_calls == r_small.num_chunks + 1
+    assert r_big.llm_calls >= r_big.num_chunks + 1
+    assert r_big.llm_calls > r_small.llm_calls
+    # totals reconcile with the backend's actual call count
+    assert r_small.llm_calls + r_big.llm_calls == len(fb.calls)

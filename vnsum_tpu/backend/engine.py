@@ -146,6 +146,7 @@ class TpuBackend:
         self._seg_fns: dict = {}
         self._compact_fn = None
         self._seed = seed
+        self._dispatch = 0
 
         if params is None:
             t0 = time.time()
@@ -340,7 +341,9 @@ class TpuBackend:
         )
 
     def _get_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig):
-        key = (B, S, max_new, gen)
+        # seed is a runtime argument to the compiled program, not a trace
+        # constant — exclude it from the cache key so seed sweeps reuse code
+        key = (B, S, max_new, gen.with_(seed=0))
         if key not in self._fns:
             t0 = time.time()
             self._fns[key] = self._make_fn(B, S, max_new, gen)
@@ -406,7 +409,7 @@ class TpuBackend:
         return jax.jit(compact)
 
     def _get_seg_fn(self, kind: str, B: int, S: int, max_new: int, gen):
-        key = (kind, B, S, max_new, gen)
+        key = (kind, B, S, max_new, gen.with_(seed=0))
         if key not in self._seg_fns:
             t0 = time.time()
             builder = {
@@ -418,8 +421,20 @@ class TpuBackend:
             self.stats.compile_seconds += time.time() - t0
         return self._seg_fns[key]
 
+    def _next_seed(self, gen: GenerationConfig) -> int:
+        """Per-batch PRNG seed folded from (config seed, engine seed, dispatch
+        index). Sampled batches draw fresh randomness instead of replaying one
+        stream, while a same-seed rerun over the same prompt sequence replays
+        bit-exactly (the dispatch counter advances identically). Greedy decode
+        ignores the key entirely, so bucket-order changes can't affect parity."""
+        s = (
+            gen.seed * 0x9E3779B1 + self._seed * 0x85EBCA77 + self._dispatch
+        ) & 0x7FFFFFFF
+        self._dispatch += 1
+        return s
+
     def _run_group_continuous(
-        self, group, encoded, max_new: int, gen, results
+        self, group, encoded, max_new: int, gen, results, seed: int
     ) -> None:
         """Generate one prompt group with segmented decode + tail compaction.
 
@@ -434,9 +449,7 @@ class TpuBackend:
 
         prefill = self._get_seg_fn("prefill", B, S, max_new, gen)
         with annotate(f"prefill[B={B},S={S}]"):
-            cur, cache, done, key_data = prefill(
-                self.params, tokens, pads, self._seed
-            )
+            cur, cache, done, key_data = prefill(self.params, tokens, pads, seed)
         self.stats.batches += 1
         self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
 
@@ -555,20 +568,29 @@ class TpuBackend:
         order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
         results: list[str | None] = [None] * len(encoded)
         t0 = time.time()
-        data_size = self.mesh.shape.get("data", 1) if self.mesh is not None else 1
         # the segmented path only pays off when the budget spans multiple
         # segments (otherwise there is nothing to compact and the extra
-        # prefill/segment dispatches cost ~3% on a homogeneous batch)
-        continuous = self.continuous and max_new > self.segment_tokens
+        # prefill/segment dispatches cost ~3% on a homogeneous batch); with
+        # temperature>0 compaction reshapes the batch mid-stream, which would
+        # silently change sampled outputs vs the one-shot path, so sampling
+        # always takes the one-shot program
+        continuous = (
+            self.continuous
+            and max_new > self.segment_tokens
+            and gen.temperature == 0.0
+        )
         for start in range(0, len(order), self.batch_size):
             group = order[start : start + self.batch_size]
+            seed = self._next_seed(gen)
             if continuous:
-                self._run_group_continuous(group, encoded, max_new, gen, results)
+                self._run_group_continuous(
+                    group, encoded, max_new, gen, results, seed
+                )
                 continue
             tokens, pad_lens, B, S = self._pack_group(group, encoded, max_new)
             fn = self._get_fn(B, S, max_new, gen)
             with annotate(f"generate[B={B},S={S}]"):
-                out = np.asarray(fn(self.params, tokens, pad_lens, self._seed))
+                out = np.asarray(fn(self.params, tokens, pad_lens, seed))
             self.stats.batches += 1
             self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
             for row, i in enumerate(group):

@@ -131,6 +131,12 @@ class PipelineConfig:
                 "every entry would silently run the same weights — run one "
                 "model per weights_dir"
             )
+        if self.weights_dir and self.backend != "tpu":
+            raise ValueError(
+                f"weights_dir requires backend='tpu' (got {self.backend!r}); "
+                "other backends would silently ignore the checkpoint and "
+                "evaluate a different model"
+            )
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

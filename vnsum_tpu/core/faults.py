@@ -139,13 +139,40 @@ def call_with_retries(
             time.sleep(delay)
 
 
-class RetryingBackend:
-    """Generic retry wrapper for any Backend's generate()."""
+# error classes a retry can never fix (programming or input errors, not
+# transient device/network state) — shared fail-fast filter for every retry
+# seam (RetryingBackend, pipeline batch retry)
+PERMANENT_ERRORS = (
+    FileNotFoundError, TypeError, ValueError, KeyError, AttributeError,
+    IndexError, NotImplementedError,
+)
 
-    def __init__(self, inner, max_retries: int = 2, backoff: float = 1.0) -> None:
+
+class RetryingBackend:
+    """Generic retry wrapper for any Backend's generate().
+
+    Permanent errors (bad config/input — see PERMANENT_ERRORS) fail fast
+    instead of burning backoff, mirroring the ollama and pipeline seams; pass
+    `should_retry` to override."""
+
+    def __init__(
+        self,
+        inner,
+        max_retries: int = 2,
+        backoff: float = 1.0,
+        should_retry=None,
+    ) -> None:
         self.inner = inner
         self.max_retries = max_retries
         self.backoff = backoff
+        # json.JSONDecodeError subclasses ValueError but is a garbled-body
+        # transient (the ollama seam retries it too, ollama.py:86-123)
+        import json
+
+        self.should_retry = should_retry or (
+            lambda e: isinstance(e, json.JSONDecodeError)
+            or not isinstance(e, PERMANENT_ERRORS)
+        )
         self.name = inner.name  # preflight dispatches on the backend kind
         self.label = f"{inner.name}+retry"
 
@@ -154,6 +181,7 @@ class RetryingBackend:
             lambda: self.inner.generate(prompts, **kw),
             max_retries=self.max_retries,
             backoff=self.backoff,
+            should_retry=self.should_retry,
             what=f"{self.inner.name}.generate({len(prompts)} prompts)",
         )
 

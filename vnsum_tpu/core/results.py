@@ -19,12 +19,19 @@ from typing import Any
 
 @dataclass
 class DocumentRecord:
-    """Per-document processing details (ref :575-582)."""
+    """Per-document processing details (ref :575-582).
+
+    `num_chunks` and `llm_calls` are TRUE per-document counts (each prompt in
+    a shared batch belongs to exactly one document). `processing_time` is the
+    document's even share of its batch's wall-clock — device batches serve
+    many documents at once, so per-doc wall time is not separable; the parent
+    ModelRunRecord declares this via ``time_basis``."""
 
     filename: str
     num_chunks: int
     processing_time: float
     summary_length_chars: int
+    llm_calls: int = 0
     status: str = "success"
     error: str | None = None
 
@@ -42,6 +49,9 @@ class ModelRunRecord:
     total_time: float = 0.0
     status: str = "success"
     error: str | None = None
+    # how per-doc processing_time was measured: "batch_amortized" (even share
+    # of the shared device batch) vs the reference's serial "per_document"
+    time_basis: str = "batch_amortized"
     processing_details: list[DocumentRecord] = field(default_factory=list)
 
     @property

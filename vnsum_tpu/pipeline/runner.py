@@ -17,6 +17,7 @@ from pathlib import Path
 
 from ..backend.base import Backend, get_backend
 from ..core.config import PipelineConfig
+from ..core.faults import PERMANENT_ERRORS as _PERMANENT_ERRORS
 from ..core.faults import call_with_retries
 from ..core.logging import get_logger, setup_run_logging
 from ..core.profiling import Tracer, device_profile
@@ -27,14 +28,6 @@ from ..strategies import get_strategy
 from ..text import DocumentTree, clean_thinking_tokens
 
 logger = get_logger("vnsum.pipeline")
-
-
-# error classes a batch retry can never fix (programming or input errors,
-# not transient device/network state)
-_PERMANENT_ERRORS = (
-    FileNotFoundError, TypeError, ValueError, KeyError, AttributeError,
-    IndexError, NotImplementedError,
-)
 
 
 def model_name_safe(model: str) -> str:
@@ -273,6 +266,8 @@ class PipelineRunner:
                 continue
 
             batch_time = time.time() - batch_t0
+            # wall time is amortized (record.time_basis); chunk/call counts
+            # are true per-document values from the strategy
             per_doc_time = batch_time / max(len(results), 1)
             for name, res in results:
                 summary = clean_thinking_tokens(res.summary)  # ref :560-561
@@ -282,7 +277,8 @@ class PipelineRunner:
                 record.total_chunks += res.num_chunks
                 record.processing_details.append(
                     DocumentRecord(
-                        name, res.num_chunks, per_doc_time, len(summary)
+                        name, res.num_chunks, per_doc_time, len(summary),
+                        llm_calls=res.llm_calls,
                     )
                 )
             logger.info(

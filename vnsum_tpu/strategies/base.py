@@ -34,17 +34,26 @@ class Strategy(Protocol):
 
 
 class _BatchCounter:
-    """Wraps backend.generate to count calls for StrategyResult accounting."""
+    """Wraps backend.generate to count calls for StrategyResult accounting.
+
+    Although rounds batch prompts across documents, every prompt belongs to
+    exactly one document — callers pass ``owners`` (one doc index per prompt)
+    so `calls_by_owner` carries TRUE per-document llm_calls, matching what the
+    reference's serial loop records (run_full_evaluation_pipeline.py:575-582)."""
 
     def __init__(self, backend: Backend, max_new_tokens: int | None = None):
         self.backend = backend
         self.max_new_tokens = max_new_tokens
-        self.calls = 0
+        self.calls_by_owner: dict[int, int] = {}
 
-    def __call__(self, prompts: list[str]) -> list[str]:
+    def __call__(self, prompts: list[str], owners: list[int] | None = None) -> list[str]:
         if not prompts:
             return []
-        self.calls += len(prompts)
+        if owners is not None:
+            if len(owners) != len(prompts):
+                raise ValueError("owners must tag every prompt")
+            for o in owners:
+                self.calls_by_owner[o] = self.calls_by_owner.get(o, 0) + 1
         return self.backend.generate(prompts, max_new_tokens=self.max_new_tokens)
 
 

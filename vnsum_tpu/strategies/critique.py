@@ -69,12 +69,17 @@ class MapReduceCritiqueStrategy:
             max_new_tokens=config.max_new_tokens, **kw,
         )
 
-    # one batched reduce→critique→refine pass over (texts, refs, iteration)
+    # one batched reduce→critique→refine pass over (texts, refs, iteration);
+    # ``owners`` maps each item to its document for per-doc call accounting
     def _reduce_with_critique_batch(
-        self, gen: _BatchCounter, items: list[tuple[list[str], list[str], int]]
+        self,
+        gen: _BatchCounter,
+        items: list[tuple[list[str], list[str], int]],
+        owners: list[int],
     ) -> list[str]:
         summaries = gen(
-            [CRITIQUE_REDUCE.format(docs=_tag_sections(texts)) for texts, _, _ in items]
+            [CRITIQUE_REDUCE.format(docs=_tag_sections(texts)) for texts, _, _ in items],
+            owners=owners,
         )
         need = [
             i for i, (_, _, it) in enumerate(items)
@@ -87,7 +92,8 @@ class MapReduceCritiqueStrategy:
                     original_chunks=_REF_JOIN.join(items[i][1]),
                 )
                 for i in need
-            ]
+            ],
+            owners=[owners[i] for i in need],
         )
         refine_idx: list[int] = []
         refine_prompts: list[str] = []
@@ -103,7 +109,8 @@ class MapReduceCritiqueStrategy:
                     reference_content=_REF_JOIN.join(items[i][1]),
                 )
             )
-        for i, refined in zip(refine_idx, gen(refine_prompts)):
+        refined_outs = gen(refine_prompts, owners=[owners[i] for i in refine_idx])
+        for i, refined in zip(refine_idx, refined_outs):
             summaries[i] = refined
         return summaries
 
@@ -120,7 +127,7 @@ class MapReduceCritiqueStrategy:
             for di, chunks in enumerate(chunks_per_doc)
             for c in chunks
         ]
-        outs = gen([p for _, p in flat])
+        outs = gen([p for _, p in flat], owners=[di for di, _ in flat])
         collapsed: list[list[str]] = [[] for _ in docs]
         for (di, _), out in zip(flat, outs):
             collapsed[di].append(out)
@@ -147,7 +154,7 @@ class MapReduceCritiqueStrategy:
                     cursor += len(g)
                     items.append((g, refs or g, crit_iters[di]))
                     owners.append(di)
-            outs = self._reduce_with_critique_batch(gen, items)
+            outs = self._reduce_with_critique_batch(gen, items, owners)
             for di in pending:
                 collapsed[di] = []
             for di, out in zip(owners, outs):
@@ -172,7 +179,7 @@ class MapReduceCritiqueStrategy:
                 for g in split_by_token_budget(collapsed[di], half, self.count):
                     items.append((g, g, crit_iters[di]))
                     owners.append(di)
-            outs = self._reduce_with_critique_batch(gen, items)
+            outs = self._reduce_with_critique_batch(gen, items, owners)
             for di in need_rc:
                 context[di] = []
             for di, out in zip(owners, outs):
@@ -181,10 +188,11 @@ class MapReduceCritiqueStrategy:
         finals = self._reduce_with_critique_batch(
             gen,
             [(collapsed[di], context[di], crit_iters[di]) for di in range(len(docs))],
+            list(range(len(docs))),
         )
         for di, f in enumerate(finals):
             results[di].summary = f
-            results[di].llm_calls = gen.calls
+            results[di].llm_calls = gen.calls_by_owner.get(di, 0)
         return results
 
     def summarize(self, doc: str) -> StrategyResult:

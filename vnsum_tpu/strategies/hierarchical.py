@@ -62,24 +62,26 @@ class HierarchicalStrategy:
         )
 
     def _mapreduce_texts_batch(
-        self, gen: _BatchCounter, texts: list[str]
+        self, gen: _BatchCounter, texts: list[str], owners: list[int]
     ) -> tuple[list[str], list[int]]:
         """Mini map-reduce over several independent texts: map all chunks of
         all texts in one batch, then one reduce per text (single round, like
-        the reference's simple graph :125-154). Returns (summaries,
-        per-text chunk counts)."""
+        the reference's simple graph :125-154). ``owners`` maps each text to
+        its tree for per-doc call accounting. Returns (summaries, per-text
+        chunk counts)."""
         chunks_per = [self.splitter.split_text(t) or [t] for t in texts]
         flat = [
             (ti, HIERARCHICAL_MAP.format(content=c))
             for ti, chunks in enumerate(chunks_per)
             for c in chunks
         ]
-        outs = gen([p for _, p in flat])
+        outs = gen([p for _, p in flat], owners=[owners[ti] for ti, _ in flat])
         per_text: list[list[str]] = [[] for _ in texts]
         for (ti, _), out in zip(flat, outs):
             per_text[ti].append(out)
         reduces = gen(
-            [HIERARCHICAL_REDUCE.format(docs="\n\n".join(s)) for s in per_text]
+            [HIERARCHICAL_REDUCE.format(docs="\n\n".join(s)) for s in per_text],
+            owners=owners,
         )
         return reduces, [len(c) for c in chunks_per]
 
@@ -111,7 +113,7 @@ class HierarchicalStrategy:
                     texts.append(f"{title}:\n{body}" if title else body)
             if not texts:
                 continue
-            summaries, chunk_counts = self._mapreduce_texts_batch(gen, texts)
+            summaries, chunk_counts = self._mapreduce_texts_batch(gen, texts, owners)
             for ri, node, summary, n in zip(owners, nodes, summaries, chunk_counts):
                 title = node.get("text", "") or ""
                 replace_node_with_paragraph(
@@ -122,12 +124,15 @@ class HierarchicalStrategy:
                 results[ri].rounds += 1
 
         final_texts = [extract_descendant_paragraph_text(r) for r in roots]
-        finals, final_counts = self._mapreduce_texts_batch(gen, final_texts)
-        polished = gen([HIERARCHICAL_POLISH.format(summary=f) for f in finals])
+        all_ris = list(range(len(roots)))
+        finals, final_counts = self._mapreduce_texts_batch(gen, final_texts, all_ris)
+        polished = gen(
+            [HIERARCHICAL_POLISH.format(summary=f) for f in finals], owners=all_ris
+        )
         for ri, p in enumerate(polished):
             results[ri].summary = p
             results[ri].num_chunks = max(total_chunks[ri] + final_counts[ri], 1)
-            results[ri].llm_calls = gen.calls
+            results[ri].llm_calls = gen.calls_by_owner.get(ri, 0)
         return results
 
     # plain-text entry: treat the whole document as a single Document node

@@ -58,7 +58,7 @@ class IterativeStrategy:
                     )
                     for di in idx
                 ]
-            outs = gen(prompts)
+            outs = gen(prompts, owners=idx)
             for di, out in zip(idx, outs):
                 summaries[di] = out
 
@@ -66,7 +66,7 @@ class IterativeStrategy:
             StrategyResult(
                 summary=summaries[di],
                 num_chunks=len(chunks_per_doc[di]),
-                llm_calls=gen.calls,
+                llm_calls=gen.calls_by_owner.get(di, 0),
                 rounds=len(chunks_per_doc[di]),
             )
             for di in range(len(docs))

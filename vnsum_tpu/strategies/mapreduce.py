@@ -71,7 +71,7 @@ class MapReduceStrategy:
             for di, chunks in enumerate(chunks_per_doc)
             for c in chunks
         ]
-        outs = gen([p for _, p in flat])
+        outs = gen([p for _, p in flat], owners=[di for di, _ in flat])
         summaries: list[list[str]] = [[] for _ in docs]
         for (di, _), out in zip(flat, outs):
             summaries[di].append(out)
@@ -94,7 +94,7 @@ class MapReduceStrategy:
                 for gi, g in enumerate(groups):
                     batch.append((di, gi))
                     prompts.append(self._reduce_one(g))
-            outs = gen(prompts)
+            outs = gen(prompts, owners=[di for di, _ in batch])
             for di in pending:
                 summaries[di] = [None] * len(grouped[di])  # type: ignore[list-item]
             for (di, gi), out in zip(batch, outs):
@@ -103,12 +103,13 @@ class MapReduceStrategy:
                 results[di].rounds += 1
 
         # final reduce, batched across documents
-        finals = gen([self._reduce_one(s) for s in summaries])
-        for r, f in zip(results, finals):
+        finals = gen(
+            [self._reduce_one(s) for s in summaries],
+            owners=list(range(len(docs))),
+        )
+        for di, (r, f) in enumerate(zip(results, finals)):
             r.summary = f
-            # per-doc counts aren't separable across shared batches; expose
-            # the batch total on every result
-            r.llm_calls = gen.calls
+            r.llm_calls = gen.calls_by_owner.get(di, 0)
         return results
 
     def summarize(self, doc: str) -> StrategyResult:

@@ -44,9 +44,9 @@ class TruncatedStrategy:
     def summarize_batch(self, docs: list[str]) -> list[StrategyResult]:
         gen = _BatchCounter(self.backend, self.max_new_tokens)
         prompts = [TRUNCATED.format(text=self._truncate(d)) for d in docs]
-        outs = gen(prompts)
+        outs = gen(prompts, owners=list(range(len(docs))))
         return [
-            StrategyResult(summary=o, num_chunks=1, llm_calls=gen.calls, rounds=1)
+            StrategyResult(summary=o, num_chunks=1, llm_calls=1, rounds=1)
             for o in outs
         ]
 

@@ -126,7 +126,12 @@ class Trainer:
         if self.tc.context_parallel:
             attention_fn = partial(ring_attention, mesh=mesh)
 
-        data_spec = NamedSharding(mesh, P(AXES.data, None))
+        # with fsdp the batch shards over BOTH axes, so the fsdp axis also
+        # acts as data parallelism (true ZeRO-3: partitioned compute plus
+        # sharded params/optimizer) instead of replicating the forward and
+        # doing fsdp-fold redundant FLOPs for a memory-only win
+        batch_axes = (AXES.data, AXES.fsdp) if self.tc.fsdp else AXES.data
+        data_spec = NamedSharding(mesh, P(batch_axes, None))
 
         def step(params, opt_state, tokens, loss_mask):
             loss, grads = jax.value_and_grad(lm_loss)(
@@ -172,6 +177,15 @@ class Trainer:
     def step(self, tokens, loss_mask=None):
         """One optimizer step; tokens [B, S] int32. Returns float loss."""
         tokens = jnp.asarray(tokens, jnp.int32)
+        batch_div = self.mesh.shape.get(AXES.data, 1)
+        if self.tc.fsdp:
+            batch_div *= self.mesh.shape.get(AXES.fsdp, 1)
+        if tokens.shape[0] % batch_div:
+            raise ValueError(
+                f"batch size {tokens.shape[0]} must be divisible by "
+                f"data{'×fsdp' if self.tc.fsdp else ''} mesh axes ({batch_div}); "
+                "with fsdp=True the batch shards over both axes"
+            )
         if loss_mask is None:
             loss_mask = jnp.ones_like(tokens, dtype=bool)
         t0 = time.time()
