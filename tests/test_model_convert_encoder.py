@@ -1,0 +1,176 @@
+"""BERT-family encoder conversion: embedding parity against transformers.
+
+The correctness anchor for real-encoder metrics (VERDICT r1 #4): a tiny
+random HF BertModel is converted and both models must produce near-identical
+token embeddings; a saved checkpoint round-trips through safetensors and
+EmbeddingModel.from_hf must reproduce sentence-transformers-style mean-pooled
+embeddings (reference encoders: evaluate/evaluate_summaries_semantic.py:
+128-133, :577-582).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from vnsum_tpu.models.convert_encoder import (
+    convert_torch_encoder,
+    encoder_config_from_hf,
+    load_hf_encoder,
+)
+from vnsum_tpu.models.encoder import encode, mean_pool
+
+HF_CFG = dict(
+    vocab_size=512,
+    hidden_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    intermediate_size=128,
+    max_position_embeddings=128,
+    layer_norm_eps=1e-12,
+)
+
+CORPUS = [
+    "Nền kinh tế Việt Nam tăng trưởng nhanh trong quý một.",
+    "Chính phủ ban hành nghị định mới về thuế thu nhập.",
+    "Người dân thành phố Hồ Chí Minh đón lễ hội lớn.",
+    "Các doanh nghiệp xuất khẩu gạo đạt kỷ lục mới.",
+] * 4
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch.manual_seed(0)
+    cfg = transformers.BertConfig(**{
+        "vocab_size": HF_CFG["vocab_size"],
+        "hidden_size": HF_CFG["hidden_size"],
+        "num_hidden_layers": HF_CFG["num_hidden_layers"],
+        "num_attention_heads": HF_CFG["num_attention_heads"],
+        "intermediate_size": HF_CFG["intermediate_size"],
+        "max_position_embeddings": HF_CFG["max_position_embeddings"],
+    })
+    return transformers.BertModel(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def converted(hf_model):
+    cfg = encoder_config_from_hf(HF_CFG)
+    params = convert_torch_encoder(hf_model, cfg)
+    return cfg, params
+
+
+def _token_batch(seed=0, B=3, S=12, vocab=512):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(5, vocab, size=(B, S)).astype(np.int32)
+    mask = np.ones((B, S), dtype=bool)
+    mask[1, 8:] = False  # ragged lengths exercise the attention mask
+    mask[2, 5:] = False
+    toks[~mask] = 0
+    return toks, mask
+
+
+def test_token_embedding_parity(hf_model, converted):
+    cfg, params = converted
+    toks, mask = _token_batch()
+    ours = np.asarray(encode(params, cfg, jnp.asarray(toks), jnp.asarray(mask)))
+    with torch.no_grad():
+        theirs = hf_model(
+            input_ids=torch.tensor(toks, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    # only compare unmasked positions (padded positions are garbage-in on
+    # both sides but attend differently)
+    np.testing.assert_allclose(ours[mask], theirs[mask], atol=2e-5)
+
+
+def test_segment_embedding_folded(hf_model, converted):
+    """token_type_embeddings[0] must be folded into the word table."""
+    cfg, params = converted
+    folded = np.asarray(params["tok_embed"][7])
+    sd = hf_model.state_dict()
+    expect = (
+        sd["embeddings.word_embeddings.weight"][7]
+        + sd["embeddings.token_type_embeddings.weight"][0]
+    ).numpy()
+    np.testing.assert_allclose(folded, expect, atol=1e-6)
+
+
+def test_checkpoint_roundtrip_and_sentence_parity(tmp_path):
+    """save_pretrained → load_hf_encoder → EmbeddingModel.from_hf must equal
+    torch BertModel + attention-mask mean pooling (the sentence-transformers
+    recipe) on real tokenized Vietnamese text."""
+    from vnsum_tpu.eval.embedding import EmbeddingModel
+    from vnsum_tpu.models.fixtures import make_tiny_hf_encoder_checkpoint
+
+    ckpt = tmp_path / "tiny_bert"
+    make_tiny_hf_encoder_checkpoint(ckpt, CORPUS, vocab_size=512)
+
+    model = EmbeddingModel.from_hf(str(ckpt), batch_size=4)
+    texts = CORPUS[:3] + ["một câu hoàn toàn mới về thời tiết"]
+    ours = model.sentence_embeddings(texts)
+
+    hf_tok = transformers.AutoTokenizer.from_pretrained(str(ckpt))
+    hf_model = transformers.AutoModel.from_pretrained(str(ckpt)).eval()
+    enc = hf_tok(texts, padding=True, return_tensors="pt")
+    with torch.no_grad():
+        out = hf_model(**enc).last_hidden_state
+    m = enc["attention_mask"].unsqueeze(-1).float()
+    pooled = (out * m).sum(1) / m.sum(1).clamp(min=1.0)
+    theirs = torch.nn.functional.normalize(pooled, dim=-1).numpy()
+
+    np.testing.assert_allclose(ours, theirs, atol=3e-5)
+    # embeddings are discriminative: self-sim > cross-sim
+    sims = ours @ ours.T
+    assert sims[0, 0] > sims[0, 3]
+
+
+def test_load_hf_encoder_config(tmp_path):
+    from vnsum_tpu.models.fixtures import make_tiny_hf_encoder_checkpoint
+
+    ckpt = tmp_path / "tiny_bert"
+    info = make_tiny_hf_encoder_checkpoint(ckpt, CORPUS, vocab_size=512)
+    cfg, params = load_hf_encoder(str(ckpt))
+    assert cfg.vocab_size == info["vocab_size"]
+    assert params["layers"]["wq"].shape == (2, 64, 64)
+
+
+def test_pipeline_embedding_dir_end_to_end(tmp_path):
+    """--embedding-dir chain: pipeline eval runs with a converted real-format
+    BERT checkpoint instead of random init."""
+    from vnsum_tpu.core.config import PipelineConfig
+    from vnsum_tpu.data.synthesize import synthesize_corpus
+    from vnsum_tpu.models.fixtures import make_tiny_hf_encoder_checkpoint
+    from vnsum_tpu.pipeline.cli import build_parser, config_from_args
+    from vnsum_tpu.pipeline.runner import PipelineRunner
+
+    synthesize_corpus(
+        tmp_path / "corpus", n_docs=3, tokens_per_doc=200, summary_tokens=30,
+        seed=2,
+    )
+    docs = [
+        p.read_text(encoding="utf-8")
+        for p in sorted((tmp_path / "corpus/doc").glob("*.txt"))
+    ]
+    make_tiny_hf_encoder_checkpoint(tmp_path / "bert", docs, vocab_size=512)
+
+    args = build_parser().parse_args([
+        "--backend", "fake",
+        "--embedding-dir", str(tmp_path / "bert"),
+        "--docs-dir", str(tmp_path / "corpus/doc"),
+        "--summary-dir", str(tmp_path / "corpus/summary"),
+        "--generated-summaries-dir", str(tmp_path / "gen"),
+        "--results-dir", str(tmp_path / "results"),
+        "--chunk-size", "100",
+        "--max-new-tokens", "16",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.evaluation.embedding_dir == str(tmp_path / "bert")
+    cfg.logs_dir = str(tmp_path / "logs")
+    results = PipelineRunner(cfg).run()
+    ev = results.evaluation["llama3.2:3b"]
+    assert 0.0 <= ev["bert_scores"]["bert_f1"] <= 1.0
+    assert -1.0 <= ev["semantic_similarity"]["mean"] <= 1.0

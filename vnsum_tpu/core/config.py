@@ -49,6 +49,10 @@ class EvalConfig:
     """Evaluation stack settings (ref run_full_evaluation_pipeline.py:984-990)."""
 
     embedding_model: str = "all-MiniLM-L6-v2"
+    # local HF BERT-family checkpoint dir (config.json + safetensors +
+    # tokenizer); when set, BERTScore/semsim run with converted pretrained
+    # weights (comparable to BASELINE.md) instead of random init
+    embedding_dir: str | None = None
     include_llm_eval: bool = False
     use_openrouter: bool = True
     llm_model: str = "openai/gpt-4o-mini"

@@ -148,6 +148,17 @@ PERMANENT_ERRORS = (
 )
 
 
+def is_retryable(e: BaseException) -> bool:
+    """The shared retry predicate: fail fast on PERMANENT_ERRORS, except
+    json.JSONDecodeError — it subclasses ValueError but is a garbled-body
+    transient (the ollama seam retries it too, ollama.py:86-123)."""
+    import json
+
+    return isinstance(e, json.JSONDecodeError) or not isinstance(
+        e, PERMANENT_ERRORS
+    )
+
+
 class RetryingBackend:
     """Generic retry wrapper for any Backend's generate().
 
@@ -165,14 +176,7 @@ class RetryingBackend:
         self.inner = inner
         self.max_retries = max_retries
         self.backoff = backoff
-        # json.JSONDecodeError subclasses ValueError but is a garbled-body
-        # transient (the ollama seam retries it too, ollama.py:86-123)
-        import json
-
-        self.should_retry = should_retry or (
-            lambda e: isinstance(e, json.JSONDecodeError)
-            or not isinstance(e, PERMANENT_ERRORS)
-        )
+        self.should_retry = should_retry or is_retryable
         self.name = inner.name  # preflight dispatches on the backend kind
         self.label = f"{inner.name}+retry"
 

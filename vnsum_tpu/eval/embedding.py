@@ -56,14 +56,41 @@ class EmbeddingModel:
         self.params = params if params is not None else init_encoder_params(
             jax.random.key(seed), self.cfg
         )
+        # BERT-family tokenizers carry [CLS]/[SEP]; pretrained encoders were
+        # trained with them, so wrap every sequence the way
+        # sentence-transformers does (mean pooling then includes both, per
+        # its attention-mask pooling)
+        self._cls = getattr(self.tok, "cls_id", None)
+        self._sep = getattr(self.tok, "sep_id", None)
         self._encode = jax.jit(partial(encode, cfg=self.cfg))
+
+    @classmethod
+    def from_hf(cls, model_dir: str, batch_size: int = 32, dtype=None):
+        """Load a converted HF BERT-family checkpoint + its tokenizer from a
+        local dir — makes the metrics pretrained-calibrated (comparable to
+        the reference's all-MiniLM-L6-v2 / mBERT numbers,
+        evaluate/evaluate_summaries_semantic.py:128-133, :577-582)."""
+        from ..models.convert_encoder import load_hf_encoder
+
+        config, params = load_hf_encoder(model_dir, dtype=dtype)
+        return cls(
+            config=config,
+            tokenizer=f"hf:{model_dir}",
+            params=params,
+            batch_size=batch_size,
+        )
 
     def _batch_tokens(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
         S = self.max_len
+        special = int(self._cls is not None) + int(self._sep is not None)
         toks = np.full((len(texts), S), self.tok.pad_id, dtype=np.int32)
         mask = np.zeros((len(texts), S), dtype=bool)
         for i, t in enumerate(texts):
-            ids = self.tok.encode(t)[:S]
+            ids = self.tok.encode(t)[: S - special]
+            if self._cls is not None:
+                ids = [self._cls] + ids
+            if self._sep is not None:
+                ids = ids + [self._sep]
             toks[i, : len(ids)] = ids
             mask[i, : len(ids)] = True
         return toks, mask

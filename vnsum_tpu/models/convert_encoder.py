@@ -1,0 +1,156 @@
+"""HF BERT-family checkpoint -> stacked JAX encoder pytree conversion.
+
+The reference scores semantics with sentence-transformers all-MiniLM-L6-v2
+and multilingual BERT (evaluate/evaluate_summaries_semantic.py:128-133,
+:577-582) — both BERT-architecture encoders. This module converts any such
+checkpoint (MiniLM, mBERT, PhoBERT-style BERT clones) into the stacked-layer
+pytree of :mod:`vnsum_tpu.models.encoder`, the same way
+:mod:`vnsum_tpu.models.convert` treats Llama: HF format is the interchange
+format, converted once host-side, then living as JAX arrays on device.
+
+Conversion notes:
+- HF ``Linear.weight`` is ``[out, in]``; our layouts are ``[in, out]``, so
+  every projection transposes.
+- BERT's token_type (segment) embeddings: sentence encoders always run with
+  ``token_type_ids=0``, so ``token_type_embeddings[0]`` is folded into the
+  word-embedding table at conversion time — the runtime model has no segment
+  input at all.
+- Per-layer tensors stack on a leading ``L`` dim for the ``lax.scan`` body.
+- State dicts may carry a ``bert.`` (or other encoder-attribute) prefix
+  depending on which AutoModel class saved them; the prefix is detected.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from .encoder import EncoderConfig
+
+# HF key templates (under encoder.layer.{i}.) -> our stacked-layer key
+_LAYER_KEYS: dict[str, str] = {
+    "attention.self.query.weight": "wq",
+    "attention.self.query.bias": "bq",
+    "attention.self.key.weight": "wk",
+    "attention.self.key.bias": "bk",
+    "attention.self.value.weight": "wv",
+    "attention.self.value.bias": "bv",
+    "attention.output.dense.weight": "wo",
+    "attention.output.dense.bias": "bo",
+    "attention.output.LayerNorm.weight": "attn_norm_w",
+    "attention.output.LayerNorm.bias": "attn_norm_b",
+    "intermediate.dense.weight": "w_up",
+    "intermediate.dense.bias": "b_up",
+    "output.dense.weight": "w_down",
+    "output.dense.bias": "b_down",
+    "output.LayerNorm.weight": "mlp_norm_w",
+    "output.LayerNorm.bias": "mlp_norm_b",
+}
+
+
+def encoder_config_from_hf(hf: Mapping[str, Any], **overrides) -> EncoderConfig:
+    """Build an :class:`EncoderConfig` from a parsed HF BERT ``config.json``."""
+    kw: dict[str, Any] = dict(
+        vocab_size=hf["vocab_size"],
+        dim=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        intermediate=hf["intermediate_size"],
+        max_len=hf.get("max_position_embeddings", 512),
+        norm_eps=hf.get("layer_norm_eps", 1e-12),
+    )
+    kw.update(overrides)
+    return EncoderConfig(**kw)
+
+
+def _detect_prefix(has: Callable[[str], bool]) -> str:
+    """Find the state-dict prefix in front of ``embeddings.*`` keys."""
+    for prefix in ("", "bert.", "model.", "encoder."):
+        if has(f"{prefix}embeddings.word_embeddings.weight"):
+            return prefix
+    raise KeyError(
+        "could not find embeddings.word_embeddings.weight under any known "
+        "prefix — is this a BERT-architecture checkpoint?"
+    )
+
+
+def convert_hf_encoder_state_dict(
+    get: Callable[[str], np.ndarray],
+    cfg: EncoderConfig,
+    dtype=None,
+    has: Callable[[str], bool] | None = None,
+) -> dict:
+    """Convert HF-named tensors into the stacked encoder pytree.
+
+    ``get(name)`` returns one HF tensor; ``has(name)`` (optional) reports key
+    existence for prefix detection — defaults to trying ``get``.
+    """
+    import jax.numpy as jnp
+
+    dtype = dtype or cfg.dtype
+
+    if has is None:
+        def has(name: str) -> bool:  # noqa: F811 - intentional default
+            try:
+                get(name)
+                return True
+            except (KeyError, IndexError):
+                return False
+
+    prefix = _detect_prefix(has)
+
+    def g(name: str) -> np.ndarray:
+        return np.asarray(get(prefix + name))
+
+    def conv(ours: str, arr: np.ndarray) -> np.ndarray:
+        return arr.T if ours.startswith("w") else arr  # weights transpose
+
+    layers: dict[str, list[np.ndarray]] = {k: [] for k in _LAYER_KEYS.values()}
+    for li in range(cfg.n_layers):
+        for hf_key, ours in _LAYER_KEYS.items():
+            layers[ours].append(conv(ours, g(f"encoder.layer.{li}.{hf_key}")))
+
+    # fold segment-0 embedding into the word table (see module docstring)
+    tok_embed = g("embeddings.word_embeddings.weight")
+    if has(prefix + "embeddings.token_type_embeddings.weight"):
+        tok_embed = tok_embed + g("embeddings.token_type_embeddings.weight")[0]
+
+    return {
+        "tok_embed": jnp.asarray(tok_embed, dtype),
+        "pos_embed": jnp.asarray(
+            g("embeddings.position_embeddings.weight"), dtype
+        ),
+        "embed_norm": {
+            "w": jnp.asarray(g("embeddings.LayerNorm.weight"), dtype),
+            "b": jnp.asarray(g("embeddings.LayerNorm.bias"), dtype),
+        },
+        "layers": {
+            k: jnp.asarray(np.stack(v), dtype) for k, v in layers.items()
+        },
+    }
+
+
+def load_hf_encoder(
+    model_dir: str, dtype=None, **config_overrides
+) -> tuple[EncoderConfig, dict]:
+    """Load ``config.json`` + safetensors shards from a local HF encoder dir
+    (e.g. a saved all-MiniLM-L6-v2 or bert-base-multilingual-cased checkout)."""
+    from .convert import _safetensors_getter
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        cfg = encoder_config_from_hf(json.load(f), **config_overrides)
+    get = _safetensors_getter(model_dir)
+    params = convert_hf_encoder_state_dict(get, cfg, dtype)
+    return cfg, params
+
+
+def convert_torch_encoder(model, cfg: EncoderConfig, dtype=None) -> dict:
+    """Convert an in-memory HF ``BertModel`` (tests, small models)."""
+    sd = {
+        k: v.detach().cpu().float().numpy() for k, v in model.state_dict().items()
+    }
+    return convert_hf_encoder_state_dict(
+        sd.__getitem__, cfg, dtype, has=sd.__contains__
+    )

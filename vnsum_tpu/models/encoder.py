@@ -8,7 +8,9 @@ per-pair host encodes (the reference re-encodes every pair serially, :561-575).
 
 Same stacked-layer + lax.scan design as models.llama; weights random-init by
 default (metrics are then self-consistent rather than pretrained-calibrated)
-or converted offline from a HF checkpoint.
+or converted from a HF BERT-family checkpoint via models.convert_encoder
+(token_type embeddings folded into tok_embed, post-LN residuals, biased
+projections — exact architecture match, parity-tested vs transformers).
 """
 from __future__ import annotations
 
@@ -61,9 +63,13 @@ def init_encoder_params(key: jax.Array, cfg: EncoderConfig) -> dict:
         "embed_norm": {"w": jnp.ones((D,), cfg.dtype), "b": jnp.zeros((D,), cfg.dtype)},
         "layers": {
             "wq": norm((L, D, D), next(ks)),
+            "bq": jnp.zeros((L, D), cfg.dtype),
             "wk": norm((L, D, D), next(ks)),
+            "bk": jnp.zeros((L, D), cfg.dtype),
             "wv": norm((L, D, D), next(ks)),
+            "bv": jnp.zeros((L, D), cfg.dtype),
             "wo": norm((L, D, D), next(ks)),
+            "bo": jnp.zeros((L, D), cfg.dtype),
             "attn_norm_w": jnp.ones((L, D), cfg.dtype),
             "attn_norm_b": jnp.zeros((L, D), cfg.dtype),
             "w_up": norm((L, D, I), next(ks)),
@@ -96,16 +102,19 @@ def encode(
     H, hd = cfg.n_heads, cfg.head_dim
 
     def layer_step(x, lp):
-        q = (x @ lp["wq"]).reshape(B, S, H, hd)
-        k = (x @ lp["wk"]).reshape(B, S, H, hd)
-        v = (x @ lp["wv"]).reshape(B, S, H, hd)
+        q = (x @ lp["wq"] + lp["bq"]).reshape(B, S, H, hd)
+        k = (x @ lp["wk"] + lp["bk"]).reshape(B, S, H, hd)
+        v = (x @ lp["wv"] + lp["bv"]).reshape(B, S, H, hd)
         scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(hd))
         scores = jnp.where(attn_mask, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, cfg.dim)
         x = _layernorm(
-            x + attn @ lp["wo"], lp["attn_norm_w"], lp["attn_norm_b"], cfg.norm_eps
+            x + attn @ lp["wo"] + lp["bo"],
+            lp["attn_norm_w"],
+            lp["attn_norm_b"],
+            cfg.norm_eps,
         )
         h = jax.nn.gelu(x @ lp["w_up"] + lp["b_up"])
         x = _layernorm(

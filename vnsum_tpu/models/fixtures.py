@@ -126,3 +126,74 @@ def make_tiny_hf_checkpoint(
         "loss_last": loss_last,
         "vocab_size": vocab,
     }
+
+
+def train_wordpiece_tokenizer(corpus: Iterable[str], vocab_size: int = 2048):
+    """Train a BERT-style WordPiece tokenizer; returns BertTokenizerFast
+    semantics via PreTrainedTokenizerFast ([CLS]/[SEP]/[PAD]/[UNK]/[MASK])."""
+    from tokenizers import Tokenizer, models, normalizers, pre_tokenizers, trainers
+    from tokenizers.processors import TemplateProcessing
+    from transformers import PreTrainedTokenizerFast
+
+    specials = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    tok = Tokenizer(models.WordPiece(unk_token="[UNK]"))
+    tok.normalizer = normalizers.NFC()
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.train_from_iterator(
+        corpus,
+        trainers.WordPieceTrainer(
+            vocab_size=vocab_size, special_tokens=specials, show_progress=False
+        ),
+    )
+    cls_id, sep_id = tok.token_to_id("[CLS]"), tok.token_to_id("[SEP]")
+    tok.post_processor = TemplateProcessing(
+        single="[CLS] $A [SEP]",
+        pair="[CLS] $A [SEP] $B [SEP]",
+        special_tokens=[("[CLS]", cls_id), ("[SEP]", sep_id)],
+    )
+    return PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        pad_token="[PAD]", unk_token="[UNK]", cls_token="[CLS]",
+        sep_token="[SEP]", mask_token="[MASK]",
+    )
+
+
+def make_tiny_hf_encoder_checkpoint(
+    out_dir: str | Path,
+    corpus: Sequence[str],
+    vocab_size: int = 2048,
+    dim: int = 64,
+    n_layers: int = 2,
+    n_heads: int = 4,
+    intermediate: int = 128,
+    max_len: int = 256,
+    seed: int = 0,
+) -> dict:
+    """Build a tiny HF BERT checkpoint (config.json + model.safetensors +
+    WordPiece tokenizer) at out_dir — the MiniLM/mBERT-shaped fixture for the
+    embedding-metric parity chain (reference models:
+    evaluate/evaluate_summaries_semantic.py:128-133, :577-582). For the real
+    pretrained encoders, point EmbeddingModel.from_hf at their checkout."""
+    import torch
+    import transformers
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    hf_tok = train_wordpiece_tokenizer(corpus, vocab_size=vocab_size)
+    vocab = len(hf_tok)
+
+    torch.manual_seed(seed)
+    cfg = transformers.BertConfig(
+        vocab_size=vocab,
+        hidden_size=dim,
+        num_hidden_layers=n_layers,
+        num_attention_heads=n_heads,
+        intermediate_size=intermediate,
+        max_position_embeddings=max_len,
+        pad_token_id=hf_tok.pad_token_id,
+    )
+    model = transformers.BertModel(cfg).eval()
+    model.save_pretrained(out, safe_serialization=True)
+    hf_tok.save_pretrained(out)
+    return {"vocab_size": vocab}

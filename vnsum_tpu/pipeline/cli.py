@@ -42,6 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
         "via models.convert; the checkpoint's tokenizer is used.",
     )
     p.add_argument(
+        "--embedding-dir", default=None,
+        help="local HF BERT-family checkpoint dir for the embedding metrics "
+        "(e.g. an all-MiniLM-L6-v2 checkout); converted via "
+        "models.convert_encoder so BERTScore/semsim are pretrained-calibrated",
+    )
+    p.add_argument(
         "--chunk-size", type=int, default=None,
         help="override the approach-default chunk size (tokens)",
     )
@@ -98,6 +104,8 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
             if k not in ("max_depth", "tree_json_path")
         },
     )
+    if args.embedding_dir:
+        cfg.evaluation.embedding_dir = args.embedding_dir
     return cfg
 
 

@@ -17,8 +17,7 @@ from pathlib import Path
 
 from ..backend.base import Backend, get_backend
 from ..core.config import PipelineConfig
-from ..core.faults import PERMANENT_ERRORS as _PERMANENT_ERRORS
-from ..core.faults import call_with_retries
+from ..core.faults import call_with_retries, is_retryable
 from ..core.logging import get_logger, setup_run_logging
 from ..core.profiling import Tracer, device_profile
 from ..core.results import DocumentRecord, ModelRunRecord, PipelineResults
@@ -248,7 +247,7 @@ class PipelineRunner:
                     backoff=cfg.retry_backoff,
                     # deterministic host-side bugs fail fast; re-running a
                     # multi-minute device batch can't fix a TypeError
-                    should_retry=lambda e: not isinstance(e, _PERMANENT_ERRORS),
+                    should_retry=is_retryable,
                     what=f"batch of {len(group)} docs",
                 )
             except Exception as e:
@@ -296,7 +295,15 @@ class PipelineRunner:
         if embedder is None:
             from ..eval import EmbeddingModel
 
-            embedder = EmbeddingModel(batch_size=cfg.evaluation.bert_batch_size)
+            if cfg.evaluation.embedding_dir:
+                embedder = EmbeddingModel.from_hf(
+                    cfg.evaluation.embedding_dir,
+                    batch_size=cfg.evaluation.bert_batch_size,
+                )
+            else:
+                embedder = EmbeddingModel(
+                    batch_size=cfg.evaluation.bert_batch_size
+                )
             self.embedding_model = embedder  # reuse across the model sweep
         judge = None
         if cfg.evaluation.include_llm_eval:

@@ -46,14 +46,13 @@ class _BatchCounter:
         self.max_new_tokens = max_new_tokens
         self.calls_by_owner: dict[int, int] = {}
 
-    def __call__(self, prompts: list[str], owners: list[int] | None = None) -> list[str]:
+    def __call__(self, prompts: list[str], owners: list[int]) -> list[str]:
         if not prompts:
             return []
-        if owners is not None:
-            if len(owners) != len(prompts):
-                raise ValueError("owners must tag every prompt")
-            for o in owners:
-                self.calls_by_owner[o] = self.calls_by_owner.get(o, 0) + 1
+        if len(owners) != len(prompts):
+            raise ValueError("owners must tag every prompt")
+        for o in owners:
+            self.calls_by_owner[o] = self.calls_by_owner.get(o, 0) + 1
         return self.backend.generate(prompts, max_new_tokens=self.max_new_tokens)
 
 

@@ -88,13 +88,21 @@ class HFTokenizer:
 
         self._tok = AutoTokenizer.from_pretrained(name_or_path)
         self.vocab_size = len(self._tok)
-        if self._tok.eos_token_id is None:
+        # encoder-only tokenizers (BERT/MiniLM WordPiece) have no EOS; their
+        # SEP plays the terminator role. The generation engine still needs a
+        # real terminator, so raise only when neither exists.
+        eos = self._tok.eos_token_id
+        if eos is None:
+            eos = self._tok.sep_token_id
+        if eos is None:
             raise ValueError(
-                f"tokenizer {name_or_path!r} has no eos token; the engine "
-                "needs one to terminate generation"
+                f"tokenizer {name_or_path!r} has neither eos nor sep token; "
+                "the engine needs one to terminate generation"
             )
-        self.eos_id = self._tok.eos_token_id
+        self.eos_id = eos
         self.bos_id = self._tok.bos_token_id  # may be None (no BOS prepended)
+        self.cls_id = self._tok.cls_token_id  # BERT-family only (else None)
+        self.sep_id = self._tok.sep_token_id
         pad = self._tok.pad_token_id
         self.pad_id = pad if pad is not None else self.eos_id
 
