@@ -2,7 +2,10 @@ from .base import Backend, get_backend
 from .fake import FakeBackend
 from .ollama import OllamaBackend
 
-__all__ = ["Backend", "get_backend", "FakeBackend", "OllamaBackend", "TpuBackend"]
+__all__ = [
+    "Backend", "get_backend", "FakeBackend", "OllamaBackend", "TpuBackend",
+    "LongContextBackend",
+]
 
 
 def __getattr__(name):
@@ -12,4 +15,8 @@ def __getattr__(name):
         from .engine import TpuBackend
 
         return TpuBackend
+    if name == "LongContextBackend":
+        from .long_context import LongContextBackend
+
+        return LongContextBackend
     raise AttributeError(name)

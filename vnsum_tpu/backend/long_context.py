@@ -1,0 +1,457 @@
+"""Long-context generation: ring-attention prefill + seq-sharded decode.
+
+The reference cannot run a 54k-token document through its model at all — its
+truncated strategy cuts inputs to 16384−2048 tokens
+(runners/run_summarization_ollama.py:8-13, config
+run_full_evaluation_pipeline.py:1004-1007), and the engine's one-chip path
+(`backend.engine`) clips the same way because a single chip can't hold the KV
+cache. This module removes that ceiling with sequence parallelism:
+
+- **Prefill** runs the full prompt as ONE forward with the sequence dim
+  sharded over the mesh `seq` axis: blockwise ring attention
+  (`parallel.ring`, K/V blocks rotating via `ppermute`) so no device ever
+  holds the full [S, S] scores or the full KV cache — an N-way seq axis
+  multiplies the maximum prompt length by N.
+- **Decode** keeps the prefill KV cache frozen and seq-sharded. Each step,
+  every device computes an online-softmax partial over its local cache shard;
+  partials merge over the seq axis with `pmax`/`psum` (log-sum-exp
+  renormalization), then merge again with the attention over the small
+  replicated cache of freshly generated tokens. New-token KV is appended only
+  to that replicated decode cache — the sharded prefill cache is never
+  touched again, so there is no resharding traffic in the loop.
+
+The decode step reuses `models.llama.forward` via its `stacked_attention_fn`
+seam (the decode-side cache write, RoPE, and MLP are the same code the
+one-chip engine runs); the merge math is the only new device code.
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..core.config import GenerationConfig
+from ..core.logging import get_logger
+from ..models.llama import (
+    LlamaConfig,
+    _embed_lookup,
+    _lm_head_logits,
+    _rmsnorm,
+    _rope_cos_sin,
+    cache_free_block,
+    forward,
+    init_kv_cache,
+    prefill_positions,
+)
+from ..models.sampling import sample_logits
+from ..parallel.mesh import AXES
+from ..parallel.ring import ring_attention
+from ..text.tokenizer import Tokenizer, get_tokenizer
+
+logger = get_logger("vnsum.long")
+
+_NEG = jnp.float32(-1e30)
+
+
+# -- prefill -----------------------------------------------------------------
+
+
+def long_prefill(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,     # [B, S] int32, left-padded; S sharded over `seq`
+    pad_lens: jax.Array,   # [B] int32
+    mesh: Mesh,
+    *,
+    remat: bool = True,
+):
+    """One ring-attention forward over the full (sharded) prompt.
+
+    Returns (last_logits [B, V] f32, prefill_cache {"k","v": [L, B, S, KV,
+    hd]}) with the cache's S dim sharded over the seq axis. Remat is on by
+    default: prefill is one giant forward, and recomputing block activations
+    is far cheaper than holding S-long intermediates for XLA's scheduler."""
+    B, S = tokens.shape
+    x = _embed_lookup(params["embed"], tokens, cfg.dtype)
+    positions = prefill_positions(pad_lens, S)
+    cos, sin = _rope_cos_sin(cfg, positions)
+    attention = partial(ring_attention, mesh=mesh, pad_lens=pad_lens)
+
+    def block(x, lp):
+        # ONE copy of the decoder math (models.llama.cache_free_block, the
+        # same block forward_train scans) — here the k/v become the cache
+        return cache_free_block(x, lp, cos, sin, cfg, attention)
+
+    if remat:
+        block = jax.checkpoint(block)
+
+    x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
+    # pin the stacked cache's layout: [L, B, S, KV, hd], S over seq
+    cache_spec = NamedSharding(
+        mesh, P(None, AXES.data, AXES.seq, AXES.model, None)
+    )
+    ks = jax.lax.with_sharding_constraint(ks, cache_spec)
+    vs = jax.lax.with_sharding_constraint(vs, cache_spec)
+
+    x = _rmsnorm(x[:, -1:, :], params["final_norm"], cfg.norm_eps)
+    logits = _lm_head_logits(x, params, cfg)
+    return logits[:, 0], {"k": ks, "v": vs}
+
+
+# -- decode over the sharded prefill cache -----------------------------------
+
+
+def _prefill_partial_local(q, k_loc, v_loc, pad_lens, q_per_kv, axis_name):
+    """Per-device online-softmax partial over the local prefill-cache shard,
+    merged across the seq axis inside (pmax/psum). q [B, H, hd];
+    k_loc/v_loc [B, S_loc, KV, hd]. Returns (o [B, H, hd] f32, m, l [B, H])."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, hd = q.shape
+    S_loc = k_loc.shape[1]
+    KV = k_loc.shape[2]
+    G = q_per_kv
+
+    qg = q.reshape(B, KV, G, hd)
+    scores = (
+        jnp.einsum("bkgh,bskh->bkgs", qg, k_loc,
+                   preferred_element_type=jnp.float32)
+        / jnp.sqrt(jnp.float32(hd))
+    )
+    k_pos = idx * S_loc + jnp.arange(S_loc)
+    valid = k_pos[None, :] >= pad_lens[:, None]  # [B, S_loc]
+    scores = jnp.where(valid[:, None, None], scores, _NEG)
+
+    m = jnp.max(scores, axis=-1)                      # [B, KV, G]
+    p = jnp.where(valid[:, None, None], jnp.exp(scores - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bkgs,bskh->bkgh", p, v_loc.astype(jnp.float32))
+
+    m_g = jax.lax.pmax(m, axis_name)
+    corr = jnp.exp(m - m_g)
+    l_g = jax.lax.psum(l * corr, axis_name)
+    o_g = jax.lax.psum(o * corr[..., None], axis_name)
+    return (
+        o_g.reshape(B, H, hd),
+        m_g.reshape(B, H),
+        l_g.reshape(B, H),
+    )
+
+
+def make_long_decode_attention(
+    mesh: Mesh, prefill_cache: dict, pad_lens: jax.Array, q_per_kv: int
+):
+    """Build a `stacked_attention_fn(q, cache, layer_idx)` for
+    models.llama.forward that attends over BOTH the frozen seq-sharded
+    prefill cache and the small replicated decode cache. The caller supplies
+    the decode mask via closure rebinding (`fn.set_step(t)` pattern is
+    avoided — t comes from the mask already written into `decode_mask_ref`).
+    """
+    partial_fn = shard_map(
+        partial(
+            _prefill_partial_local, q_per_kv=q_per_kv, axis_name=AXES.seq
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(AXES.data, AXES.model, None),
+            P(AXES.data, AXES.seq, AXES.model, None),
+            P(AXES.data, AXES.seq, AXES.model, None),
+            P(AXES.data),
+        ),
+        out_specs=(
+            P(AXES.data, AXES.model, None),
+            P(AXES.data, AXES.model),
+            P(AXES.data, AXES.model),
+        ),
+    )
+
+    def attention(q, cache, layer_idx, t):
+        """q [B, 1, H, hd]; cache = small decode cache [L, B, KV, C, hd];
+        attends prefill shards + decode slots 0..t."""
+        B, _, H, hd = q.shape
+        q1 = q[:, 0]
+
+        k_pre = jax.lax.dynamic_index_in_dim(
+            prefill_cache["k"], layer_idx, 0, keepdims=False
+        )
+        v_pre = jax.lax.dynamic_index_in_dim(
+            prefill_cache["v"], layer_idx, 0, keepdims=False
+        )
+        o1, m1, l1 = partial_fn(q1, k_pre, v_pre, pad_lens)
+
+        # decode-cache partial (replicated math; C = max_new is small)
+        k_dec = jax.lax.dynamic_index_in_dim(
+            cache["k"], layer_idx, 0, keepdims=False
+        )  # [B, KV, C, hd]
+        v_dec = jax.lax.dynamic_index_in_dim(
+            cache["v"], layer_idx, 0, keepdims=False
+        )
+        KV = k_dec.shape[1]
+        C = k_dec.shape[2]
+        qg = q1.reshape(B, KV, q_per_kv, hd)
+        scores = (
+            jnp.einsum("bkgh,bkch->bkgc", qg, k_dec.astype(qg.dtype),
+                       preferred_element_type=jnp.float32)
+            / jnp.sqrt(jnp.float32(hd))
+        )
+        valid = (jnp.arange(C) <= t)[None, None, None, :]
+        scores = jnp.where(valid, scores, _NEG)
+        m2 = jnp.max(scores, axis=-1)
+        p = jnp.where(valid, jnp.exp(scores - m2[..., None]), 0.0)
+        l2 = jnp.sum(p, axis=-1)
+        o2 = jnp.einsum("bkgc,bkch->bkgh", p, v_dec.astype(jnp.float32))
+        m2 = m2.reshape(B, H)
+        l2 = l2.reshape(B, H)
+        o2 = o2.reshape(B, H, hd)
+
+        # log-sum-exp merge of the two partials
+        m = jnp.maximum(m1, m2)
+        c1 = jnp.exp(m1 - m)
+        c2 = jnp.exp(m2 - m)
+        l = l1 * c1 + l2 * c2
+        o = o1 * c1[..., None] + o2 * c2[..., None]
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return out[:, None].astype(q.dtype)  # [B, 1, H, hd]
+
+    return attention
+
+
+# -- full generation program -------------------------------------------------
+
+
+def generate_long_tokens(
+    params: dict,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    tokens: jax.Array,     # [B, S] left-padded, S % seq_axis == 0
+    pad_lens: jax.Array,   # [B]
+    max_new: int,
+    *,
+    eos_ids,
+    pad_id: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    seed: int = 0,
+) -> jax.Array:
+    """Traceable end-to-end long-context generation; returns [B, max_new].
+
+    jit this with params/tokens shardings; the prompt may exceed single-chip
+    memory by the seq-axis factor."""
+    B, S = tokens.shape
+    eos = jnp.asarray(list(eos_ids), dtype=jnp.int32)
+
+    last_logits, prefill_cache = long_prefill(
+        params, cfg, tokens, pad_lens, mesh
+    )
+    key = jax.random.key(seed)
+    key, sub = jax.random.split(key)
+    first = sample_logits(last_logits, sub, temperature, top_k, top_p)
+    done0 = pad_lens == S  # all-pad filler rows start done
+
+    attention = make_long_decode_attention(
+        mesh, prefill_cache, pad_lens, cfg.q_per_kv
+    )
+    decode_cache0 = init_kv_cache(cfg, B, max_new)
+    out0 = jnp.full((B, max_new), pad_id, dtype=jnp.int32)
+
+    def cond(carry):
+        t, _cur, _cache, done, _key, _out = carry
+        return (t < max_new) & ~jnp.all(done)
+
+    def body(carry):
+        t, cur, cache, done, key, out = carry
+        emit = jnp.where(done, pad_id, cur)
+        out = jax.lax.dynamic_update_slice(out, emit[:, None], (0, t))
+        done = done | jnp.isin(cur, eos)
+        pos = (S - pad_lens) + t
+        # decode-cache mask is handled inside the attention (slots 0..t);
+        # forward()'s own mask argument covers only dense fallbacks — pass
+        # the same slot validity for shape consistency
+        mask_t = (jnp.arange(max_new) <= t)[None, None, :].repeat(B, axis=0)
+        logits, cache = forward(
+            params, cfg, cur[:, None], pos[:, None], cache, t, mask_t,
+            stacked_attention_fn=lambda q, c, li: attention(q, c, li, t),
+        )
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+        return (t + 1, nxt, cache, done, key, out)
+
+    *_, out = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), first, decode_cache0, done0, key, out0)
+    )
+    return out
+
+
+class LongContextBackend:
+    """Backend-protocol generation over a seq-sharded mesh: prompts up to
+    (seq_axis × single-chip limit) tokens run UN-truncated. Pair with
+    strategies.truncated (max_context set to the long limit) to summarize
+    VN-LongSum's 54k-token docs in one shot — a capability the reference's
+    16k context fundamentally cannot match."""
+
+    name = "tpu"
+    label = "tpu+long-context"
+
+    def __init__(
+        self,
+        model_config: LlamaConfig | None = None,
+        mesh: Mesh | None = None,
+        tokenizer: str | Tokenizer = "byte",
+        params=None,
+        batch_size: int = 1,
+        max_new_tokens: int = 1024,
+        max_total_tokens: int | None = None,
+        generation: GenerationConfig | None = None,
+        seed: int = 0,
+    ) -> None:
+        from ..models.llama import init_params, llama32_3b
+
+        if mesh is None or AXES.seq not in mesh.shape:
+            raise ValueError(
+                "LongContextBackend needs a mesh with a 'seq' axis — that "
+                "axis is what multiplies the context ceiling"
+            )
+        self.cfg = model_config or llama32_3b()
+        self.mesh = mesh
+        self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
+        # prompts here are near the memory ceiling by definition — default to
+        # one row at a time; raise only when the per-row cache share allows
+        self.batch_size = max(batch_size, mesh.shape.get(AXES.data, 1))
+        self.max_new_tokens = max_new_tokens
+        # the long path deliberately ignores cfg.max_seq_len (that is the
+        # ONE-CHIP ceiling); the real limit is RoPE numerical range + HBM
+        self.max_total_tokens = max_total_tokens or (
+            self.cfg.max_seq_len * mesh.shape[AXES.seq]
+        )
+        self.gen_cfg = generation or GenerationConfig()
+        self._seed = seed
+        self._dispatch = 0
+        self._fns: dict = {}
+        if params is None:
+            params = jax.jit(partial(init_params, cfg=self.cfg))(
+                jax.random.key(seed)
+            )
+        from ..parallel.sharding import shard_params
+
+        self.params = shard_params(params, mesh, self.cfg.tie_embeddings)
+
+    def _bucket(self, n: int) -> int:
+        """Round S up to a multiple of (seq_axis × 128) with pow2-ish steps
+        to bound recompiles."""
+        step = self.mesh.shape[AXES.seq] * 128
+        b = step
+        while b < n:
+            b *= 2
+        return min(b, ((self.max_total_tokens + step - 1) // step) * step)
+
+    def _next_seed(self, gen: GenerationConfig) -> int:
+        """Same (config seed, backend seed, dispatch index) folding as
+        TpuBackend._next_seed — sampled batches draw fresh randomness,
+        same-seed reruns replay, greedy ignores the key entirely."""
+        s = (
+            gen.seed * 0x9E3779B1 + self._seed * 0x85EBCA77 + self._dispatch
+        ) & 0x7FFFFFFF
+        self._dispatch += 1
+        return s
+
+    def generate(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+    ) -> list[str]:
+        gen = config or self.gen_cfg
+        max_new = max_new_tokens or (
+            config.max_new_tokens if config else self.max_new_tokens
+        )
+        if not prompts:
+            return []
+        data_size = self.mesh.shape.get(AXES.data, 1)
+
+        encoded = []
+        for p in prompts:
+            ids = self.tok.encode(p, add_bos=True)
+            if len(ids) > self.max_total_tokens - max_new:
+                ids = ids[: self.max_total_tokens - max_new]
+            encoded.append(ids)
+
+        # length-sorted groups of at most batch_size rows, each bucketed for
+        # ITS longest member: prompts at this scale sit near the HBM ceiling,
+        # so one giant longest-prompt batch would OOM and make every short
+        # prompt pay the longest prefill
+        order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
+        results: list[str | None] = [None] * len(encoded)
+        for start in range(0, len(order), self.batch_size):
+            group = order[start : start + self.batch_size]
+            S = self._bucket(max(len(encoded[i]) for i in group))
+            B = data_size
+            while B < len(group):
+                B *= 2
+            tokens = np.full((B, S), self.tok.pad_id, dtype=np.int32)
+            pad_lens = np.full((B,), S, dtype=np.int32)
+            for row, i in enumerate(group):
+                ids = encoded[i]
+                tokens[row, S - len(ids):] = ids
+                pad_lens[row] = S - len(ids)
+
+            fn = self._get_fn(B, S, max_new, gen)
+            t0 = time.time()
+            out = np.asarray(
+                fn(self.params, tokens, pad_lens, self._next_seed(gen))
+            )
+            logger.info(
+                "long generate: B=%d S=%d new=%d in %.1fs",
+                B, S, max_new, time.time() - t0,
+            )
+            for row, i in enumerate(group):
+                ids = []
+                for t in out[row].tolist():
+                    if t == self.tok.eos_id or t == self.tok.pad_id:
+                        break
+                    ids.append(t)
+                results[i] = self.tok.decode(ids).strip()
+        return results  # type: ignore[return-value]
+
+    def _get_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig):
+        key = (B, S, max_new, gen.with_(seed=0))
+        if key not in self._fns:
+            from ..models.quant import is_quantized
+            from ..parallel.sharding import param_shardings
+
+            ns = lambda spec: NamedSharding(self.mesh, spec)
+            eos_ids = tuple(gen.eos_ids) or (self.tok.eos_id,)
+
+            def program(params, tokens, pad_lens, seed):
+                return generate_long_tokens(
+                    params, self.cfg, self.mesh, tokens, pad_lens, max_new,
+                    eos_ids=eos_ids, pad_id=self.tok.pad_id,
+                    temperature=gen.temperature, top_k=gen.top_k,
+                    top_p=gen.top_p, seed=seed,
+                )
+
+            self._fns[key] = jax.jit(
+                program,
+                in_shardings=(
+                    param_shardings(
+                        self.mesh, self.cfg.tie_embeddings,
+                        is_quantized(self.params),
+                    ),
+                    ns(P(AXES.data, AXES.seq)),
+                    ns(P(AXES.data)),
+                    None,
+                ),
+                out_shardings=ns(P(AXES.data, None)),
+            )
+            logger.info("built long-context fn B=%d S=%d new=%d", B, S, max_new)
+        return self._fns[key]
+
+    def count_tokens(self, text: str) -> int:
+        return self.tok.count(text)

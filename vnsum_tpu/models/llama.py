@@ -413,6 +413,25 @@ def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, q_per_kv: i
     )
 
 
+def cache_free_block(x, lp, cos, sin, cfg: LlamaConfig, attention_fn):
+    """One cache-free decoder layer; returns (x, (k, v)) with k/v
+    projection-shaped [B, S, KV, hd]. Shared by forward_train (which
+    discards the k/v) and the long-context ring prefill (which stacks them
+    into the frozen prefill cache) — ONE copy of the block math."""
+    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = _proj("bsd,dhk->bshk", h, lp["wq"])
+    k = _proj("bsd,dhk->bshk", h, lp["wk"])
+    v = _proj("bsd,dhk->bshk", h, lp["wv"])
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+    attn = attention_fn(q, k, v, cfg.q_per_kv)
+    x = x + _proj("bshk,hkd->bsd", attn, lp["wo"])
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = _proj("bsd,di->bsi", h, lp["w_gate"])
+    up = _proj("bsd,di->bsi", h, lp["w_up"])
+    return x + _proj("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"]), (k, v)
+
+
 def forward_train(
     params: dict,
     cfg: LlamaConfig,
@@ -434,18 +453,8 @@ def forward_train(
     cos, sin = _rope_cos_sin(cfg, positions)
 
     def block(x, lp):
-        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = _proj("bsd,dhk->bshk", h, lp["wq"])
-        k = _proj("bsd,dhk->bshk", h, lp["wk"])
-        v = _proj("bsd,dhk->bshk", h, lp["wv"])
-        q = _apply_rope(q, cos, sin)
-        k = _apply_rope(k, cos, sin)
-        attn = attention_fn(q, k, v, cfg.q_per_kv)
-        x = x + _proj("bshk,hkd->bsd", attn, lp["wo"])
-        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = _proj("bsd,di->bsi", h, lp["w_gate"])
-        up = _proj("bsd,di->bsi", h, lp["w_up"])
-        return x + _proj("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+        x, _ = cache_free_block(x, lp, cos, sin, cfg, attention_fn)
+        return x
 
     if remat:
         block = jax.checkpoint(block)

@@ -26,8 +26,9 @@ from .mesh import AXES
 _NEG = jnp.float32(-1e30)
 
 
-def _ring_local(qb, kb, vb, q_per_kv: int, axis_name: str, causal: bool):
-    """Per-device body. qb [B, Sq, H, hd], kb/vb [B, Sk, KV, hd] (local)."""
+def _ring_local(qb, kb, vb, pad_lens, q_per_kv: int, axis_name: str, causal: bool):
+    """Per-device body. qb [B, Sq, H, hd], kb/vb [B, Sk, KV, hd] (local);
+    pad_lens [B] (or None) masks out the left-padding slots of each row."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Sq, H, hd = qb.shape
@@ -56,16 +57,24 @@ def _ring_local(qb, kb, vb, q_per_kv: int, axis_name: str, causal: bool):
                        preferred_element_type=jnp.float32)
             * scale
         )
+        k_pos = src * Sq + jnp.arange(k_cur.shape[1])
+        allowed = None
         if causal:
-            k_pos = src * Sq + jnp.arange(k_cur.shape[1])
-            allowed = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(allowed[None, None, None], scores, _NEG)
+            allowed = jnp.broadcast_to(
+                q_pos[:, None] >= k_pos[None, :], (B, Sq, k_cur.shape[1])
+            )
+        if pad_lens is not None:
+            valid = k_pos[None, None, :] >= pad_lens[:, None, None]
+            allowed = valid if allowed is None else allowed & valid
+        if allowed is not None:
+            # scores [B, KV, G, Sq, Sk]; allowed [B, Sq, Sk]
+            scores = jnp.where(allowed[:, None, None], scores, _NEG)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
         correction = jnp.exp(m - m_new)
         p = jnp.exp(scores - m_new[..., None])
-        if causal:
+        if allowed is not None:
             # a fully-masked block would otherwise give exp(_NEG-_NEG)=1
-            p = jnp.where(allowed[None, None, None], p, 0.0)
+            p = jnp.where(allowed[:, None, None], p, 0.0)
         l = l * correction + jnp.sum(p, axis=-1)
         o = o * correction[..., None] + jnp.einsum(
             "bkgsc,bckh->bkgsh", p, v_cur.astype(jnp.float32)
@@ -98,21 +107,35 @@ def ring_attention(
     *,
     mesh: Mesh,
     causal: bool = True,
+    pad_lens: jax.Array | None = None,
 ):
     """Drop-in attention_fn for models.llama.forward_train: global views
-    [B, S, H|KV, hd], sequence dim sharded over the `seq` axis."""
+    [B, S, H|KV, hd], sequence dim sharded over the `seq` axis. ``pad_lens``
+    [B] (engine-style left padding) masks the pad slots of each row so the
+    long-context prefill can reuse the ring."""
     spec_q = P(AXES.data, AXES.seq, AXES.model, None)
     spec_kv = P(AXES.data, AXES.seq, AXES.model, None)
 
+    if pad_lens is None:
+        fn = shard_map(
+            partial(
+                _ring_local,
+                pad_lens=None,
+                q_per_kv=q_per_kv,
+                axis_name=AXES.seq,
+                causal=causal,
+            ),
+            mesh=mesh,
+            in_specs=(spec_q, spec_kv, spec_kv),
+            out_specs=spec_q,
+        )
+        return fn(q, k, v)
     fn = shard_map(
         partial(
-            _ring_local,
-            q_per_kv=q_per_kv,
-            axis_name=AXES.seq,
-            causal=causal,
+            _ring_local, q_per_kv=q_per_kv, axis_name=AXES.seq, causal=causal
         ),
         mesh=mesh,
-        in_specs=(spec_q, spec_kv, spec_kv),
+        in_specs=(spec_q, spec_kv, spec_kv, P(AXES.data)),
         out_specs=spec_q,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, pad_lens)
