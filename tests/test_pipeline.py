@@ -193,3 +193,16 @@ def test_utils_tools(tmp_path):
 
     clean_summaries(d)
     assert (d / "a.txt").read_text(encoding="utf-8") == "tóm tắt"
+
+
+def test_cli_long_context_and_quantize_flags():
+    args = build_parser().parse_args([
+        "--approach", "truncated", "--backend", "tpu",
+        "--long-context", "--quantize",
+        "--mesh", "data=2,seq=4",
+        "--max-context", "65536",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.long_context and cfg.quantize
+    assert cfg.max_context == 65536
+    assert cfg.mesh_shape == {"data": 2, "seq": 4}

@@ -32,6 +32,44 @@ class Backend(Protocol):
         ...
 
 
+# -- shared device-batch helpers (TpuBackend + LongContextBackend) ----------
+# Greedy parity between the one-chip engine and the seq-sharded long-context
+# engine depends on identical packing / seed / detokenize semantics — keep
+# ONE copy of each here.
+
+
+def fold_seed(gen_seed: int, backend_seed: int, dispatch: int) -> int:
+    """Per-batch PRNG seed folded from (config seed, backend seed, dispatch
+    index): sampled batches draw fresh randomness, same-seed reruns over the
+    same call sequence replay bit-exactly, greedy ignores the key."""
+    return (
+        gen_seed * 0x9E3779B1 + backend_seed * 0x85EBCA77 + dispatch
+    ) & 0x7FFFFFFF
+
+
+def left_pad_batch(encoded_group, B: int, S: int, pad_id: int):
+    """Pack encoded prompts into a fixed-shape left-padded [B, S] batch;
+    rows beyond the group are all-pad filler. Returns (tokens, pad_lens)."""
+    import numpy as np
+
+    tokens = np.full((B, S), pad_id, dtype=np.int32)
+    pad_lens = np.full((B,), S, dtype=np.int32)
+    for row, ids in enumerate(encoded_group):
+        tokens[row, S - len(ids):] = ids
+        pad_lens[row] = S - len(ids)
+    return tokens, pad_lens
+
+
+def trim_to_eos(ids, eos_id: int, pad_id: int) -> list[int]:
+    """Cut a generated id row at its first EOS/pad slot."""
+    out: list[int] = []
+    for t in ids:
+        if t == eos_id or t == pad_id:
+            break
+        out.append(t)
+    return out
+
+
 def get_backend(spec: str, **kwargs) -> Backend:
     """Factory: "fake", "ollama", "tpu", or "hf"."""
     if spec == "fake":

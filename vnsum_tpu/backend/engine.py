@@ -26,6 +26,7 @@ import numpy as np
 
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
+from .base import fold_seed, left_pad_batch, trim_to_eos
 from ..core.profiling import annotate
 from ..models.llama import (
     LlamaConfig,
@@ -426,14 +427,7 @@ class TpuBackend:
         return self._seg_fns[key]
 
     def _next_seed(self, gen: GenerationConfig) -> int:
-        """Per-batch PRNG seed folded from (config seed, engine seed, dispatch
-        index). Sampled batches draw fresh randomness instead of replaying one
-        stream, while a same-seed rerun over the same prompt sequence replays
-        bit-exactly (the dispatch counter advances identically). Greedy decode
-        ignores the key entirely, so bucket-order changes can't affect parity."""
-        s = (
-            gen.seed * 0x9E3779B1 + self._seed * 0x85EBCA77 + self._dispatch
-        ) & 0x7FFFFFFF
+        s = fold_seed(gen.seed, self._seed, self._dispatch)
         self._dispatch += 1
         return s
 
@@ -530,12 +524,9 @@ class TpuBackend:
         while B < len(group):
             B *= 2
         B = min(B, self.batch_size)
-        tokens = np.full((B, S), self.tok.pad_id, dtype=np.int32)
-        pad_lens = np.full((B,), S, dtype=np.int32)
-        for row, i in enumerate(group):
-            ids = encoded[i]
-            tokens[row, S - len(ids):] = ids  # left padding
-            pad_lens[row] = S - len(ids)
+        tokens, pad_lens = left_pad_batch(
+            [encoded[i] for i in group], B, S, self.tok.pad_id
+        )
         return tokens, pad_lens, B, S
 
     def generate(
@@ -604,11 +595,7 @@ class TpuBackend:
 
     def _detok(self, ids: np.ndarray) -> str:
         self.stats.generated_tokens += int((ids != self.tok.pad_id).sum())
-        out: list[int] = []
-        for t in ids.tolist():
-            if t == self.tok.eos_id or t == self.tok.pad_id:
-                break
-            out.append(t)
+        out = trim_to_eos(ids.tolist(), self.tok.eos_id, self.tok.pad_id)
         return self.tok.decode(out).strip()
 
     def count_tokens(self, text: str) -> int:

@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
+from .base import fold_seed, left_pad_batch, trim_to_eos
 from ..models.llama import (
     LlamaConfig,
     _embed_lookup,
@@ -104,19 +105,38 @@ def long_prefill(
     return logits[:, 0], {"k": ks, "v": vs}
 
 
+def quantize_prefill_cache(cache: dict) -> dict:
+    """[L, B, S, KV, hd] bf16 cache -> int8 values + per-(layer, token,
+    head) f32 scales. Decode streams every shard's cache each step, so this
+    halves long-context decode HBM traffic (the engine's per-vector scheme,
+    models.llama._quantize_kv — axis-agnostic over leading dims)."""
+    from ..models.llama import _quantize_kv
+
+    k8, ks = _quantize_kv(cache["k"])
+    v8, vs = _quantize_kv(cache["v"])
+    return {"k": k8, "v": v8, "ks": ks, "vs": vs}
+
+
 # -- decode over the sharded prefill cache -----------------------------------
 
 
-def _prefill_partial_local(q, k_loc, v_loc, pad_lens, q_per_kv, axis_name):
+def _prefill_partial_local(
+    q, k_loc, v_loc, pad_lens, k_scale=None, v_scale=None, *,
+    q_per_kv, axis_name,
+):
     """Per-device online-softmax partial over the local prefill-cache shard,
     merged across the seq axis inside (pmax/psum). q [B, H, hd];
-    k_loc/v_loc [B, S_loc, KV, hd]. Returns (o [B, H, hd] f32, m, l [B, H])."""
+    k_loc/v_loc [B, S_loc, KV, hd] (int8 when k_scale/v_scale [B, S_loc, KV]
+    are given). Returns (o [B, H, hd] f32, m, l [B, H])."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, hd = q.shape
     S_loc = k_loc.shape[1]
     KV = k_loc.shape[2]
     G = q_per_kv
+    if k_scale is not None:
+        k_loc = k_loc.astype(jnp.float32) * k_scale[..., None]
+        v_loc = v_loc.astype(jnp.float32) * v_scale[..., None]
 
     qg = q.reshape(B, KV, G, hd)
     scores = (
@@ -147,23 +167,25 @@ def _prefill_partial_local(q, k_loc, v_loc, pad_lens, q_per_kv, axis_name):
 def make_long_decode_attention(
     mesh: Mesh, prefill_cache: dict, pad_lens: jax.Array, q_per_kv: int
 ):
-    """Build a `stacked_attention_fn(q, cache, layer_idx)` for
-    models.llama.forward that attends over BOTH the frozen seq-sharded
-    prefill cache and the small replicated decode cache. The caller supplies
-    the decode mask via closure rebinding (`fn.set_step(t)` pattern is
-    avoided — t comes from the mask already written into `decode_mask_ref`).
-    """
+    """Build the merged attention for models.llama.forward's
+    ``stacked_attention_fn`` seam: the returned ``attention(q, cache,
+    layer_idx, t)`` attends over BOTH the frozen seq-sharded prefill cache
+    (closure) and the small replicated decode cache, valid slots 0..t; the
+    decode loop binds ``t`` per step via a lambda."""
+    quantized = "ks" in prefill_cache
+    kv_spec = P(AXES.data, AXES.seq, AXES.model, None)
+    scale_spec = P(AXES.data, AXES.seq, AXES.model)
+    in_specs = [
+        P(AXES.data, AXES.model, None), kv_spec, kv_spec, P(AXES.data),
+    ]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
     partial_fn = shard_map(
         partial(
             _prefill_partial_local, q_per_kv=q_per_kv, axis_name=AXES.seq
         ),
         mesh=mesh,
-        in_specs=(
-            P(AXES.data, AXES.model, None),
-            P(AXES.data, AXES.seq, AXES.model, None),
-            P(AXES.data, AXES.seq, AXES.model, None),
-            P(AXES.data),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=(
             P(AXES.data, AXES.model, None),
             P(AXES.data, AXES.model),
@@ -177,13 +199,15 @@ def make_long_decode_attention(
         B, _, H, hd = q.shape
         q1 = q[:, 0]
 
-        k_pre = jax.lax.dynamic_index_in_dim(
-            prefill_cache["k"], layer_idx, 0, keepdims=False
-        )
-        v_pre = jax.lax.dynamic_index_in_dim(
-            prefill_cache["v"], layer_idx, 0, keepdims=False
-        )
-        o1, m1, l1 = partial_fn(q1, k_pre, v_pre, pad_lens)
+        def layer(name):
+            return jax.lax.dynamic_index_in_dim(
+                prefill_cache[name], layer_idx, 0, keepdims=False
+            )
+
+        args = [q1, layer("k"), layer("v"), pad_lens]
+        if quantized:
+            args += [layer("ks"), layer("vs")]
+        o1, m1, l1 = partial_fn(*args)
 
         # decode-cache partial (replicated math; C = max_new is small)
         k_dec = jax.lax.dynamic_index_in_dim(
@@ -239,17 +263,22 @@ def generate_long_tokens(
     top_k: int = 0,
     top_p: float = 1.0,
     seed: int = 0,
+    quantize_kv: bool = False,
 ) -> jax.Array:
     """Traceable end-to-end long-context generation; returns [B, max_new].
 
     jit this with params/tokens shardings; the prompt may exceed single-chip
-    memory by the seq-axis factor."""
+    memory by the seq-axis factor. ``quantize_kv`` stores the frozen prefill
+    cache int8 (decode streams every shard per step — traffic halves, and
+    the freed HBM doubles the context that fits)."""
     B, S = tokens.shape
     eos = jnp.asarray(list(eos_ids), dtype=jnp.int32)
 
     last_logits, prefill_cache = long_prefill(
         params, cfg, tokens, pad_lens, mesh
     )
+    if quantize_kv:
+        prefill_cache = quantize_prefill_cache(prefill_cache)
     key = jax.random.key(seed)
     key, sub = jax.random.split(key)
     first = sample_logits(last_logits, sub, temperature, top_k, top_p)
@@ -310,6 +339,8 @@ class LongContextBackend:
         max_total_tokens: int | None = None,
         generation: GenerationConfig | None = None,
         seed: int = 0,
+        quantize: bool = False,
+        quantize_kv: bool = False,
     ) -> None:
         from ..models.llama import init_params, llama32_3b
 
@@ -323,21 +354,38 @@ class LongContextBackend:
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
         # prompts here are near the memory ceiling by definition — default to
         # one row at a time; raise only when the per-row cache share allows
-        self.batch_size = max(batch_size, mesh.shape.get(AXES.data, 1))
+        data_size = mesh.shape.get(AXES.data, 1)
+        self.batch_size = max(batch_size, data_size)
+        if self.batch_size % data_size:
+            raise ValueError(
+                f"batch_size={self.batch_size} must be divisible by the "
+                f"mesh data axis ({data_size})"
+            )
         self.max_new_tokens = max_new_tokens
         # the long path deliberately ignores cfg.max_seq_len (that is the
         # ONE-CHIP ceiling); the real limit is RoPE numerical range + HBM
         self.max_total_tokens = max_total_tokens or (
             self.cfg.max_seq_len * mesh.shape[AXES.seq]
         )
+        if max_new_tokens >= self.max_total_tokens:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} must be < "
+                f"max_total_tokens={self.max_total_tokens}"
+            )
         self.gen_cfg = generation or GenerationConfig()
         self._seed = seed
         self._dispatch = 0
         self._fns: dict = {}
+        self.quantize_kv = bool(quantize_kv)
         if params is None:
             params = jax.jit(partial(init_params, cfg=self.cfg))(
                 jax.random.key(seed)
             )
+        if quantize:
+            from ..models.quant import is_quantized, quantize_params
+
+            if not is_quantized(params):
+                params = jax.jit(quantize_params)(params)
         from ..parallel.sharding import shard_params
 
         self.params = shard_params(params, mesh, self.cfg.tie_embeddings)
@@ -352,12 +400,7 @@ class LongContextBackend:
         return min(b, ((self.max_total_tokens + step - 1) // step) * step)
 
     def _next_seed(self, gen: GenerationConfig) -> int:
-        """Same (config seed, backend seed, dispatch index) folding as
-        TpuBackend._next_seed — sampled batches draw fresh randomness,
-        same-seed reruns replay, greedy ignores the key entirely."""
-        s = (
-            gen.seed * 0x9E3779B1 + self._seed * 0x85EBCA77 + self._dispatch
-        ) & 0x7FFFFFFF
+        s = fold_seed(gen.seed, self._seed, self._dispatch)
         self._dispatch += 1
         return s
 
@@ -372,6 +415,11 @@ class LongContextBackend:
         max_new = max_new_tokens or (
             config.max_new_tokens if config else self.max_new_tokens
         )
+        if max_new >= self.max_total_tokens:
+            raise ValueError(
+                f"max_new_tokens={max_new} must be < "
+                f"max_total_tokens={self.max_total_tokens}"
+            )
         if not prompts:
             return []
         data_size = self.mesh.shape.get(AXES.data, 1)
@@ -395,12 +443,13 @@ class LongContextBackend:
             B = data_size
             while B < len(group):
                 B *= 2
-            tokens = np.full((B, S), self.tok.pad_id, dtype=np.int32)
-            pad_lens = np.full((B,), S, dtype=np.int32)
-            for row, i in enumerate(group):
-                ids = encoded[i]
-                tokens[row, S - len(ids):] = ids
-                pad_lens[row] = S - len(ids)
+            # batch_size is the caller's HBM high-water mark — never exceed
+            # it just to reach a power of two (batch_size % data == 0 is
+            # checked at construction, so the clamp stays shardable)
+            B = min(B, self.batch_size)
+            tokens, pad_lens = left_pad_batch(
+                [encoded[i] for i in group], B, S, self.tok.pad_id
+            )
 
             fn = self._get_fn(B, S, max_new, gen)
             t0 = time.time()
@@ -412,11 +461,9 @@ class LongContextBackend:
                 B, S, max_new, time.time() - t0,
             )
             for row, i in enumerate(group):
-                ids = []
-                for t in out[row].tolist():
-                    if t == self.tok.eos_id or t == self.tok.pad_id:
-                        break
-                    ids.append(t)
+                ids = trim_to_eos(
+                    out[row].tolist(), self.tok.eos_id, self.tok.pad_id
+                )
                 results[i] = self.tok.decode(ids).strip()
         return results  # type: ignore[return-value]
 
@@ -435,6 +482,7 @@ class LongContextBackend:
                     eos_ids=eos_ids, pad_id=self.tok.pad_id,
                     temperature=gen.temperature, top_k=gen.top_k,
                     top_p=gen.top_p, seed=seed,
+                    quantize_kv=self.quantize_kv,
                 )
 
             self._fns[key] = jax.jit(

@@ -110,6 +110,14 @@ class PipelineConfig:
     batch_size: int = 8
     tokenizer: str = "byte"  # byte | hf:<name-or-path>
     mesh_shape: dict[str, int] = field(default_factory=dict)
+    # ring-attention prefill + seq-sharded decode (backend/long_context.py):
+    # prompts run UN-truncated up to seq_axis × the one-chip limit; requires
+    # backend=tpu and a mesh with a seq axis > 1
+    long_context: bool = False
+    # int8 weight-only quantization (per-output-channel scales — exact
+    # w.r.t. the quantized weights; models/quant.py). The engine's decode is
+    # weight-bandwidth-bound, so this is most of the single-chip speedup
+    quantize: bool = False
     dtype: str = "bfloat16"
     # local HF checkpoint dir (config.json + *.safetensors + tokenizer files)
     # for the tpu backend: weights are converted via models.convert and the
@@ -141,6 +149,23 @@ class PipelineConfig:
                 "other backends would silently ignore the checkpoint and "
                 "evaluate a different model"
             )
+        if self.quantize and self.backend != "tpu":
+            raise ValueError(
+                f"quantize requires backend='tpu' (got {self.backend!r}); "
+                "other backends would silently run full-precision while the "
+                "run record claims int8"
+            )
+        if self.long_context:
+            if self.backend != "tpu":
+                raise ValueError(
+                    f"long_context requires backend='tpu' (got {self.backend!r})"
+                )
+            if self.mesh_shape.get("seq", 1) < 2:
+                raise ValueError(
+                    "long_context requires a mesh with a seq axis > 1 "
+                    "(e.g. --mesh seq=4,data=2) — the seq axis is what "
+                    "multiplies the context ceiling"
+                )
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

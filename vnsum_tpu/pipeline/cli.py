@@ -36,6 +36,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--mesh", default="", help='device mesh, e.g. "data=2,model=4"'
     )
     p.add_argument(
+        "--quantize", action="store_true",
+        help="int8 weight-only quantization for the tpu backend (halves "
+        "decode HBM traffic; the KV cache quantizes automatically when the "
+        "Pallas kernels are active)",
+    )
+    p.add_argument(
+        "--long-context", action="store_true",
+        help="ring-attention prefill + seq-sharded decode: prompts run "
+        "un-truncated up to seq_axis × the one-chip limit (requires "
+        "--backend tpu and --mesh with seq>1); pair with --approach "
+        "truncated --max-context <long limit> for one-shot full-document "
+        "summaries",
+    )
+    p.add_argument(
         "--weights-dir", default=None,
         help="local HF checkpoint dir for the tpu backend (config.json + "
         "safetensors + tokenizer); e.g. a Llama-3.2-3B checkout. Converted "
@@ -59,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--max-new-tokens", type=int, default=None,
         help="override the approach-default generation budget",
     )
+    p.add_argument(
+        "--max-context", type=int, default=None,
+        help="truncated approach: context budget in tokens (ref default "
+        "16384); with --long-context this may exceed the one-chip limit",
+    )
     return p
 
 
@@ -69,7 +88,7 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         for part in args.mesh.split(","):
             k, v = part.split("=")
             mesh_shape[k.strip()] = int(v)
-    for key in ("chunk_size", "token_max", "max_new_tokens"):
+    for key in ("chunk_size", "token_max", "max_new_tokens", "max_context"):
         val = getattr(args, key)
         if val is not None:
             overrides[key] = val
@@ -96,6 +115,8 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         batch_size=args.batch_size,
         tokenizer=args.tokenizer,
         mesh_shape=mesh_shape,
+        long_context=args.long_context,
+        quantize=args.quantize,
         tree_json_path=args.tree_json,
         max_depth=args.max_depth,
         **{

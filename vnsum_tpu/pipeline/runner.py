@@ -73,9 +73,27 @@ class PipelineRunner:
         if cfg.backend == "tpu":
             mesh = None
             if cfg.mesh_shape:
+                import math
+
+                import jax
+
                 from ..parallel import make_mesh
 
-                mesh = make_mesh(dict(cfg.mesh_shape))
+                # the axon plugin keeps TPU default regardless of
+                # JAX_PLATFORMS; when the requested mesh needs more devices
+                # than the default platform has but the host CPU pool fits
+                # (tests, dry runs), build the mesh there instead
+                platform = None
+                need = math.prod(v for v in cfg.mesh_shape.values() if v > 0)
+                if need > len(jax.devices()) and need <= len(jax.devices("cpu")):
+                    logger.info(
+                        "mesh %s exceeds default platform; using cpu devices",
+                        cfg.mesh_shape,
+                    )
+                    platform = "cpu"
+                mesh = make_mesh(dict(cfg.mesh_shape), platform=platform)
+            if cfg.long_context:
+                return self._long_context_backend(model, mesh)
             if cfg.weights_dir:
                 # real checkpoint: convert safetensors + use its tokenizer
                 # (quality-parity chain; reference loads HF checkpoints at
@@ -100,6 +118,7 @@ class PipelineRunner:
                     mesh=mesh,
                     batch_size=cfg.batch_size,
                     max_new_tokens=cfg.max_new_tokens,
+                    quantize=cfg.quantize,
                 )
             from ..models import MODEL_REGISTRY
 
@@ -115,8 +134,58 @@ class PipelineRunner:
                 mesh=mesh,
                 batch_size=cfg.batch_size,
                 max_new_tokens=cfg.max_new_tokens,
+                quantize=cfg.quantize,
             )
         raise ValueError(f"unknown backend {cfg.backend!r}")
+
+    def _long_context_backend(self, model: str, mesh) -> Backend:
+        """Seq-sharded generation (backend/long_context.py): full documents
+        run un-truncated — no equivalent exists in the reference (its hard
+        16k cut: run_full_evaluation_pipeline.py:1004-1007)."""
+        cfg = self.config
+        from ..backend.long_context import LongContextBackend
+
+        params = None
+        model_cfg = None
+        tokenizer = cfg.tokenizer
+        if cfg.weights_dir:
+            import jax.numpy as jnp
+
+            from ..models.convert import load_hf_checkpoint
+
+            model_cfg, params = load_hf_checkpoint(
+                cfg.weights_dir, dtype=getattr(jnp, cfg.dtype)
+            )
+            if not tokenizer.startswith("hf:"):
+                tokenizer = f"hf:{cfg.weights_dir}"
+        else:
+            from ..models import MODEL_REGISTRY
+
+            if model not in MODEL_REGISTRY:
+                raise ValueError(
+                    f"unknown model {model!r} for tpu backend; "
+                    f"have {sorted(MODEL_REGISTRY)}"
+                )
+            model_cfg = MODEL_REGISTRY[model]()
+        return LongContextBackend(
+            model_config=model_cfg,
+            mesh=mesh,
+            tokenizer=tokenizer,
+            params=params,
+            batch_size=cfg.batch_size,
+            max_new_tokens=cfg.max_new_tokens,
+            # the truncated strategy cuts the DOCUMENT to max_context −
+            # max_new and then wraps it in a prompt template; give the
+            # backend headroom for that template so it never chops the
+            # closing instruction off a cap-length prompt
+            max_total_tokens=(
+                cfg.max_context + 1024 if cfg.approach == "truncated" else None
+            ),
+            quantize=cfg.quantize,
+            # cfg.quantize promises weight-only (exact) quantization; int8
+            # prefill-cache quantization is lossy, so it stays API-opt-in
+            quantize_kv=False,
+        )
 
     def preflight(self, backend: Backend) -> None:
         """Backend health check before any work (ref :199-233 checked the
@@ -167,7 +236,14 @@ class PipelineRunner:
 
         backend = self.backend_factory(model)
         self.preflight(backend)
-        strategy = get_strategy(cfg.approach, backend, cfg)
+        strategy_kw = {}
+        if cfg.approach == "truncated" and getattr(backend, "tok", None) is not None:
+            # the truncated cut must count tokens with the backend's OWN
+            # tokenizer — weights_dir/long-context runs rewrite it to the
+            # checkpoint's HF tokenizer, and a byte-token cut there would
+            # over-truncate ~4x
+            strategy_kw["tokenizer"] = backend.tok
+        strategy = get_strategy(cfg.approach, backend, cfg, **strategy_kw)
 
         ds = DocumentDataset(cfg.docs_dir, cfg.summary_dir)
         out_dir = self._output_dir(model)
