@@ -148,8 +148,13 @@ def run_e2e_bench(params) -> dict:
     # tests/test_backend_continuous.py). The probe also pre-warms the
     # dominant (B=8, S=8192) programs.
     sample_doc = open(f"{root}/corpus/doc/doc_000.txt", encoding="utf-8").read()
+    # slice by BYTES (the engine's token metric): char slices of Vietnamese
+    # run ~1.3 bytes/char and would land the probe in a bucket the pipeline
+    # never uses, wasting its compile instead of pre-warming S=8192
+    raw = sample_doc.encode("utf-8")
     probe_prompts = [
-        f"Tóm tắt: {sample_doc[i * 7000:(i + 1) * 7000]}" for i in range(8)
+        "Tóm tắt: " + raw[i * 7000 : (i + 1) * 7000].decode("utf-8", "ignore")
+        for i in range(8)
     ]
     probe = backend.generate(probe_prompts)
     eos = _pick_ragged_eos(probe)

@@ -38,8 +38,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--quantize", action="store_true",
         help="int8 weight-only quantization for the tpu backend (halves "
-        "decode HBM traffic; the KV cache quantizes automatically when the "
-        "Pallas kernels are active)",
+        "decode HBM traffic). The one-chip engine's KV cache quantizes "
+        "automatically whenever its Pallas kernels are active (independent "
+        "of this flag); the long-context prefill cache stays exact — its "
+        "lossy int8 mode is API-only (LongContextBackend(quantize_kv=True))",
     )
     p.add_argument(
         "--long-context", action="store_true",

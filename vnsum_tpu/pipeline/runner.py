@@ -92,45 +92,38 @@ class PipelineRunner:
                     )
                     platform = "cpu"
                 mesh = make_mesh(dict(cfg.mesh_shape), platform=platform)
+            model_cfg, params, tokenizer = self._resolve_model(model)
             if cfg.long_context:
-                return self._long_context_backend(model, mesh)
-            if cfg.weights_dir:
-                # real checkpoint: convert safetensors + use its tokenizer
-                # (quality-parity chain; reference loads HF checkpoints at
-                # runners/run_summarization.py:54-62)
-                import jax.numpy as jnp
+                from ..backend.long_context import LongContextBackend
 
-                from ..models.convert import load_hf_checkpoint
-
-                model_cfg, params = load_hf_checkpoint(
-                    cfg.weights_dir, dtype=getattr(jnp, cfg.dtype)
-                )
-                tokenizer = (
-                    cfg.tokenizer
-                    if cfg.tokenizer.startswith("hf:")
-                    else f"hf:{cfg.weights_dir}"
-                )
-                return get_backend(
-                    "tpu",
+                return LongContextBackend(
                     model_config=model_cfg,
-                    params=params,
-                    tokenizer=tokenizer,
                     mesh=mesh,
+                    tokenizer=tokenizer,
+                    params=params,
                     batch_size=cfg.batch_size,
                     max_new_tokens=cfg.max_new_tokens,
+                    # the truncated strategy cuts the DOCUMENT to
+                    # max_context − max_new and then wraps it in a prompt
+                    # template; give the backend headroom for that template
+                    # so it never chops the closing instruction off a
+                    # cap-length prompt
+                    max_total_tokens=(
+                        cfg.max_context + 1024
+                        if cfg.approach == "truncated"
+                        else None
+                    ),
                     quantize=cfg.quantize,
-                )
-            from ..models import MODEL_REGISTRY
-
-            if model not in MODEL_REGISTRY:
-                raise ValueError(
-                    f"unknown model {model!r} for tpu backend; "
-                    f"have {sorted(MODEL_REGISTRY)}"
+                    # cfg.quantize promises weight-only (exact)
+                    # quantization; int8 prefill-cache quantization is
+                    # lossy, so it stays API-opt-in
+                    quantize_kv=False,
                 )
             return get_backend(
                 "tpu",
-                model_config=MODEL_REGISTRY[model](),
-                tokenizer=cfg.tokenizer,
+                model_config=model_cfg,
+                params=params,
+                tokenizer=tokenizer,
                 mesh=mesh,
                 batch_size=cfg.batch_size,
                 max_new_tokens=cfg.max_new_tokens,
@@ -138,16 +131,15 @@ class PipelineRunner:
             )
         raise ValueError(f"unknown backend {cfg.backend!r}")
 
-    def _long_context_backend(self, model: str, mesh) -> Backend:
-        """Seq-sharded generation (backend/long_context.py): full documents
-        run un-truncated — no equivalent exists in the reference (its hard
-        16k cut: run_full_evaluation_pipeline.py:1004-1007)."""
-        cfg = self.config
-        from ..backend.long_context import LongContextBackend
+    def _resolve_model(self, model: str):
+        """(model_config, params, tokenizer) for the tpu backends — ONE copy
+        of the checkpoint-load / tokenizer-rewrite / registry-lookup rules.
 
-        params = None
-        model_cfg = None
-        tokenizer = cfg.tokenizer
+        With weights_dir set, safetensors convert + the checkpoint's own
+        tokenizer (quality-parity chain; reference loads HF checkpoints at
+        runners/run_summarization.py:54-62); otherwise a registry config
+        with random init (benchmarks, tests)."""
+        cfg = self.config
         if cfg.weights_dir:
             import jax.numpy as jnp
 
@@ -156,36 +148,20 @@ class PipelineRunner:
             model_cfg, params = load_hf_checkpoint(
                 cfg.weights_dir, dtype=getattr(jnp, cfg.dtype)
             )
-            if not tokenizer.startswith("hf:"):
-                tokenizer = f"hf:{cfg.weights_dir}"
-        else:
-            from ..models import MODEL_REGISTRY
+            tokenizer = (
+                cfg.tokenizer
+                if cfg.tokenizer.startswith("hf:")
+                else f"hf:{cfg.weights_dir}"
+            )
+            return model_cfg, params, tokenizer
+        from ..models import MODEL_REGISTRY
 
-            if model not in MODEL_REGISTRY:
-                raise ValueError(
-                    f"unknown model {model!r} for tpu backend; "
-                    f"have {sorted(MODEL_REGISTRY)}"
-                )
-            model_cfg = MODEL_REGISTRY[model]()
-        return LongContextBackend(
-            model_config=model_cfg,
-            mesh=mesh,
-            tokenizer=tokenizer,
-            params=params,
-            batch_size=cfg.batch_size,
-            max_new_tokens=cfg.max_new_tokens,
-            # the truncated strategy cuts the DOCUMENT to max_context −
-            # max_new and then wraps it in a prompt template; give the
-            # backend headroom for that template so it never chops the
-            # closing instruction off a cap-length prompt
-            max_total_tokens=(
-                cfg.max_context + 1024 if cfg.approach == "truncated" else None
-            ),
-            quantize=cfg.quantize,
-            # cfg.quantize promises weight-only (exact) quantization; int8
-            # prefill-cache quantization is lossy, so it stays API-opt-in
-            quantize_kv=False,
-        )
+        if model not in MODEL_REGISTRY:
+            raise ValueError(
+                f"unknown model {model!r} for tpu backend; "
+                f"have {sorted(MODEL_REGISTRY)}"
+            )
+        return MODEL_REGISTRY[model](), None, cfg.tokenizer
 
     def preflight(self, backend: Backend) -> None:
         """Backend health check before any work (ref :199-233 checked the
