@@ -354,12 +354,20 @@ class LongContextBackend:
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
         # prompts here are near the memory ceiling by definition — default to
         # one row at a time; raise only when the per-row cache share allows.
-        # Round DOWN to a data-axis multiple: the value is the caller's HBM
-        # high-water mark, and shardability must not push past it
+        # Rounded DOWN to a data-axis multiple (the value is the caller's
+        # HBM high-water mark) — except that at least data_size rows must
+        # exist to shard over the data axis at all, so a smaller request is
+        # floored up. Either adjustment is loud: memory budgets depend on it.
         data_size = mesh.shape.get(AXES.data, 1)
         self.batch_size = max(
             data_size, (max(batch_size, 1) // data_size) * data_size
         )
+        if self.batch_size != batch_size:
+            logger.warning(
+                "batch_size adjusted %d -> %d (mesh data axis %d needs a "
+                "divisible row count); per-dispatch memory scales with it",
+                batch_size, self.batch_size, data_size,
+            )
         self.max_new_tokens = max_new_tokens
         # the long path deliberately ignores cfg.max_seq_len (that is the
         # ONE-CHIP ceiling); the real limit is RoPE numerical range + HBM
