@@ -105,6 +105,20 @@ def main() -> None:
 
     hf_tok = AutoTokenizer.from_pretrained(str(work / "ckpt"))
     doc_bpe_lens = [len(hf_tok.encode(d)) for d in docs]
+    # enforce the artifact's headline claims — a parameter choice that
+    # falsifies them must fail the run, not write a misleading artifact
+    if not all(n > one_chip_ceiling for n in doc_bpe_lens):
+        raise RuntimeError(
+            f"doc lengths {doc_bpe_lens} do not all exceed the one-chip "
+            f"ceiling ({one_chip_ceiling}); raise --tokens-per-doc"
+        )
+    strategy_cut = cfg.max_context - cfg.max_new_tokens
+    if any(n > strategy_cut for n in doc_bpe_lens):
+        raise RuntimeError(
+            f"doc lengths {doc_bpe_lens} exceed the truncated strategy's "
+            f"cut ({strategy_cut}); the 'UN-truncated' claim would be false "
+            "— raise --max-context or lower --tokens-per-doc"
+        )
 
     artifact = {
         "what": (

@@ -292,3 +292,20 @@ def test_long_backend_rejects_budget_exceeding_context(mesh):
     )
     with pytest.raises(ValueError, match="max_new_tokens"):
         be.generate(["x"], max_new_tokens=600)
+
+
+def test_greedy_parity_with_model_axis_active():
+    """TP x SP composition: heads sharded over `model` AND sequence over
+    `seq` must still match the dense single-device engine bit-for-bit."""
+    mesh = make_mesh({"data": 1, "model": 2, "seq": 4}, platform="cpu")
+    cfg = tiny_llama(max_seq_len=2048)
+    params = init_params(jax.random.key(13), cfg)
+    dense = TpuBackend(
+        model_config=cfg, params=params, batch_size=2, max_new_tokens=12,
+        continuous=False,
+    )
+    long = LongContextBackend(
+        model_config=cfg, mesh=mesh, params=params, batch_size=2,
+        max_new_tokens=12, max_total_tokens=2048,
+    )
+    assert long.generate(PROMPTS) == dense.generate(PROMPTS)
