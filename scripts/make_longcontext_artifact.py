@@ -79,6 +79,7 @@ def main() -> None:
         backend="tpu",
         long_context=True,
         mesh_shape={"data": 2, "seq": 4},
+        allow_cpu_mesh=True,  # 8-way mesh on the 1-chip host runs on CPU
         weights_dir=str(work / "ckpt"),
         max_context=4096,
         max_new_tokens=96,

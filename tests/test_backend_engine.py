@@ -250,8 +250,9 @@ def test_early_exit_matches_reference_rollout(engine):
 
 def test_eos_early_exit_stops_output(engine):
     """Forcing EOS to the first greedily-chosen token stops decode right
-    after it: the EOS token itself is emitted (scan-era semantics), every
-    later slot stays pad."""
+    after it, and the custom stop token is STRIPPED from the decoded text
+    like the native EOS (ADVICE r2: it is emitted into the raw buffer
+    before the done check, but must never leak into the summary)."""
     prompt = "một đoạn văn"
     full = engine.generate([prompt])[0]
     if not full:
@@ -262,8 +263,26 @@ def test_eos_early_exit_stops_output(engine):
         max_new_tokens=engine.max_new_tokens,
         config=GenerationConfig(temperature=0.0, eos_ids=(first_id,)),
     )[0]
-    assert out == engine.tok.decode([first_id]).strip()
+    assert out == ""
     assert len(out) < len(full)
+
+
+def test_custom_eos_mid_stream_is_stripped(engine):
+    """A custom stop token hit mid-stream cuts the text there and does not
+    itself appear in the output."""
+    prompt = "một đoạn văn"
+    full = engine.generate([prompt])[0]
+    ids = engine.tok.encode(full, add_bos=False)
+    if len(ids) < 3:
+        pytest.skip("rollout too short for a mid-stream stop")
+    stop = ids[2]
+    out = engine.generate(
+        [prompt],
+        max_new_tokens=engine.max_new_tokens,
+        config=GenerationConfig(temperature=0.0, eos_ids=(stop,)),
+    )[0]
+    expect = engine.tok.decode(ids[: ids.index(stop)]).strip()
+    assert out == expect
 
 
 def test_sampled_batches_draw_fresh_randomness():

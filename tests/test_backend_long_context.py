@@ -202,6 +202,7 @@ def test_pipeline_long_context_truncated_untruncated(tmp_path):
         backend="tpu",
         long_context=True,
         mesh_shape={"data": 2, "seq": 4},
+        allow_cpu_mesh=True,  # 8-way mesh on a host whose default is 1 chip
         max_context=2048,
         max_new_tokens=8,
         batch_size=2,

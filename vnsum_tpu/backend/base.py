@@ -60,14 +60,33 @@ def left_pad_batch(encoded_group, B: int, S: int, pad_id: int):
     return tokens, pad_lens
 
 
-def trim_to_eos(ids, eos_id: int, pad_id: int) -> list[int]:
-    """Cut a generated id row at its first EOS/pad slot."""
+def trim_to_eos(
+    ids, eos_id: int, pad_id: int, extra_eos: tuple[int, ...] = ()
+) -> list[int]:
+    """Cut a generated id row at its first EOS/pad slot. ``extra_eos`` carries
+    the active GenerationConfig.eos_ids — custom stop tokens are emitted
+    before the done check fires, so they must be stripped like native EOS."""
+    stops = {eos_id, pad_id, *extra_eos}
     out: list[int] = []
     for t in ids:
-        if t == eos_id or t == pad_id:
+        if t in stops:
             break
         out.append(t)
     return out
+
+
+def resolve_max_new(
+    max_new_tokens: int | None, config, backend_default: int
+) -> int:
+    """Decode-budget resolution shared by every backend: explicit argument >
+    explicit config override > the backend's constructor default. A config
+    passed only for temperature/eos (max_new_tokens=None) keeps the
+    constructor budget."""
+    if max_new_tokens is not None:
+        return max_new_tokens
+    if config is not None and config.max_new_tokens is not None:
+        return config.max_new_tokens
+    return backend_default
 
 
 def get_backend(spec: str, **kwargs) -> Backend:

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
-from .base import fold_seed, left_pad_batch, trim_to_eos
+from .base import fold_seed, left_pad_batch, resolve_max_new, trim_to_eos
 from ..core.profiling import annotate
 from ..models.llama import (
     LlamaConfig,
@@ -38,7 +38,7 @@ from ..models.llama import (
     prefill_attention_mask,
     prefill_positions,
 )
-from ..models.sampling import sample_logits
+from ..models.sampling import sample_logits_rows
 from ..text.tokenizer import Tokenizer, get_tokenizer
 
 logger = get_logger("vnsum.engine")
@@ -178,9 +178,16 @@ class TpuBackend:
         """The two traceable halves every generation program is composed of:
 
         prefill_part(params, tokens, pad_lens, seed)
-            -> (first_token, cache, done0, key)
-        decode_part(params, t0, cur, cache, done, key, out, pad_lens, t_end)
-            -> (t, cur, cache, done, key, out)
+            -> (first_token, cache, done0)
+        decode_part(params, t0, cur, cache, done, uids, out, pad_lens,
+                    t_end, seed)
+            -> (t, cur, cache, done, out)
+
+        Sampling is counter-based per row: step t of row uid draws from
+        fold_in(fold_in(key(seed), uid), t). A row's stream therefore
+        depends only on (seed, uid, t) — never on its batch position — so
+        the continuous scheduler can compact a sampled batch mid-decode
+        with bit-identical surviving outputs (greedy was always safe).
 
         The one-shot program is prefill + one decode to t_end=max_new in a
         single jit; the continuous scheduler jits them separately and runs
@@ -238,17 +245,23 @@ class TpuBackend:
                 params, cfg, tokens, positions, cache, 0, mask,
                 last_only=True, stacked_attention_fn=prefill_stacked_fn,
             )
-            key = jax.random.key(seed)
-            key, sub = jax.random.split(key)
-            first = sample_logits(
-                logits[:, -1], sub, gen.temperature, gen.top_k, gen.top_p
+            base = jax.random.key(seed)
+            uids0 = jnp.arange(B, dtype=jnp.int32)
+            keys0 = jax.vmap(
+                lambda u: jax.random.fold_in(jax.random.fold_in(base, u), 0)
+            )(uids0)
+            first = sample_logits_rows(
+                logits[:, -1], keys0, gen.temperature, gen.top_k, gen.top_p
             )
             # all-pad dummy rows (batch bucketing filler) start done, else
             # their garbage decode would keep the early exit from firing
             done0 = pad_lens == S
-            return first, cache, done0, key
+            return first, cache, done0
 
-        def decode_part(params, t0, cur, cache, done, key, out, pad_lens, t_end):
+        def decode_part(
+            params, t0, cur, cache, done, uids, out, pad_lens, t_end, seed
+        ):
+            base = jax.random.key(seed)
             # decode loop with early exit: a while_loop instead of a fixed
             # lax.scan, so the program stops as soon as every row has hit
             # EOS (real summaries end far before the max_new budget)
@@ -258,11 +271,11 @@ class TpuBackend:
                 return out, done | jnp.isin(cur, eos)
 
             def cond(carry):
-                t, _cur, _cache, done, _key, _out = carry
+                t, _cur, _cache, done, _out = carry
                 return (t < t_end) & ~jnp.all(done)
 
             def body(carry):
-                t, cur, cache, done, key, out = carry
+                t, cur, cache, done, out = carry
                 out, done = emit_token(out, cur, done, t)
                 pos = (S - pad_lens) + t
                 mask_t = decode_attention_mask(pad_lens, S + t, C)
@@ -288,17 +301,22 @@ class TpuBackend:
                     params, cfg, cur[:, None], pos[:, None], cache, S + t,
                     mask_t, stacked_attention_fn=stacked_fn,
                 )
-                key, sub = jax.random.split(key)
-                nxt = sample_logits(
-                    logits[:, -1], sub, gen.temperature, gen.top_k, gen.top_p
+                step_keys = jax.vmap(
+                    lambda u: jax.random.fold_in(
+                        jax.random.fold_in(base, u), t + 1
+                    )
+                )(uids)
+                nxt = sample_logits_rows(
+                    logits[:, -1], step_keys,
+                    gen.temperature, gen.top_k, gen.top_p,
                 )
-                return (t + 1, nxt, cache, done, key, out)
+                return (t + 1, nxt, cache, done, out)
 
             # each iteration emits BEFORE sampling, so on exit (budget spent
             # or all rows done) every live slot is already written and the
             # rest remain pad from the init — identical to a full-length scan
             return jax.lax.while_loop(
-                cond, body, (t0, cur, cache, done, key, out)
+                cond, body, (t0, cur, cache, done, out)
             )
 
         return prefill_part, decode_part
@@ -308,11 +326,12 @@ class TpuBackend:
         prefill_part, decode_part = self._make_parts(B, S, max_new, gen)
 
         def generate(params, tokens, pad_lens, seed):
-            first, cache, done0, key = prefill_part(params, tokens, pad_lens, seed)
+            first, cache, done0 = prefill_part(params, tokens, pad_lens, seed)
             out0 = jnp.full((B, max_new), pad_id, dtype=jnp.int32)
+            uids = jnp.arange(B, dtype=jnp.int32)
             *_, out = decode_part(
-                params, jnp.int32(0), first, cache, done0, key, out0,
-                pad_lens, max_new,
+                params, jnp.int32(0), first, cache, done0, uids, out0,
+                pad_lens, max_new, seed,
             )
             return out  # [B, max_new]
 
@@ -375,8 +394,7 @@ class TpuBackend:
         prefill_part, _ = self._make_parts(B, S, max_new, gen)
 
         def prefill(params, tokens, pad_lens, seed):
-            first, cache, done0, key = prefill_part(params, tokens, pad_lens, seed)
-            return first, cache, done0, jax.random.key_data(key)
+            return prefill_part(params, tokens, pad_lens, seed)
 
         if self.mesh is not None:
             return jax.jit(prefill, in_shardings=self._mesh_in_shardings())
@@ -391,13 +409,12 @@ class TpuBackend:
         _, decode_part = self._make_parts(B, S, max_new, gen)
         seg = self.segment_tokens
 
-        def segment(params, t0, cur, cache, done, key_data, out, pad_lens):
-            key = jax.random.wrap_key_data(key_data)
+        def segment(params, t0, cur, cache, done, uids, out, pad_lens, seed):
             t_end = jnp.minimum(t0 + seg, max_new)
-            t, cur, cache, done, key, out = decode_part(
-                params, t0, cur, cache, done, key, out, pad_lens, t_end
+            t, cur, cache, done, out = decode_part(
+                params, t0, cur, cache, done, uids, out, pad_lens, t_end, seed
             )
-            return t, cur, cache, done, jax.random.key_data(key), out
+            return t, cur, cache, done, out
 
         # donate the cache and out buffers: segments overwrite them in place
         return jax.jit(segment, donate_argnums=(3, 6))
@@ -438,8 +455,10 @@ class TpuBackend:
 
         After each segment the done mask is fetched; when the live rows fit
         a half-size (or smaller) program, finished rows are harvested and
-        the survivors gathered into it. Greedy output is identical to the
-        one-shot path — each row's stream depends only on its own cache."""
+        the survivors gathered into it. Output is identical to the one-shot
+        path for greedy AND sampled decode — greedy depends only on the
+        row's own cache, and sampled streams are keyed by (seed, row uid,
+        step), not batch position."""
         tokens, pads, B, S = self._pack_group(group, encoded, max_new)
         rows: list[int | None] = [None] * B
         for row, i in enumerate(group):
@@ -447,12 +466,15 @@ class TpuBackend:
 
         prefill = self._get_seg_fn("prefill", B, S, max_new, gen)
         with annotate(f"prefill[B={B},S={S}]"):
-            cur, cache, done, key_data = prefill(self.params, tokens, pads, seed)
+            cur, cache, done = prefill(self.params, tokens, pads, seed)
         self.stats.batches += 1
         self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
 
         out = jnp.full((B, max_new), self.tok.pad_id, dtype=jnp.int32)
         pad_dev = jnp.asarray(pads)
+        # per-row RNG identity: sampling keys fold in the row's INITIAL slot
+        # index, carried across compactions so surviving streams never change
+        uid_of_slot = list(range(B))
         t = jnp.int32(0)
         if self._compact_fn is None:
             self._compact_fn = self._make_compact_fn()
@@ -461,8 +483,10 @@ class TpuBackend:
         while True:
             segment = self._get_seg_fn("segment", B, S, max_new, gen)
             with annotate(f"decode_seg[B={B},S={S}]"):
-                t, cur, cache, done, key_data, out = segment(
-                    self.params, t, cur, cache, done, key_data, out, pad_dev
+                t, cur, cache, done, out = segment(
+                    self.params, t, cur, cache, done,
+                    np.asarray(uid_of_slot, dtype=np.int32), out, pad_dev,
+                    seed,
                 )
             done_h = np.asarray(done)
             t_h = int(t)
@@ -486,7 +510,7 @@ class TpuBackend:
                 out_h = np.asarray(out)
                 for r in live:
                     if done_h[r]:  # harvest leaving rows
-                        results[rows[r]] = self._detok(out_h[r])
+                        results[rows[r]] = self._detok(out_h[r], tuple(gen.eos_ids))
                 # pad the gather index with done slots (kept inert by done=True)
                 filler = [r for r in range(B) if r not in active]
                 idx = active + filler[: B_new - len(active)]
@@ -495,6 +519,7 @@ class TpuBackend:
                     cache, cur, done, out, pad_dev, idx_dev
                 )
                 rows = [rows[r] if r in active else None for r in idx]
+                uid_of_slot = [uid_of_slot[r] for r in idx]
                 B = B_new
                 self.stats.compactions += 1
                 self.stats.compacted_batch_sizes.append(B_new)
@@ -506,7 +531,7 @@ class TpuBackend:
         out_h = np.asarray(out)
         for r, orig in enumerate(rows):
             if orig is not None and results[orig] is None:
-                results[orig] = self._detok(out_h[r])
+                results[orig] = self._detok(out_h[r], tuple(gen.eos_ids))
 
     # -- public API ------------------------------------------------------
 
@@ -537,9 +562,7 @@ class TpuBackend:
         config: GenerationConfig | None = None,
     ) -> list[str]:
         gen = config or self.gen_cfg
-        max_new = max_new_tokens or (
-            config.max_new_tokens if config else self.max_new_tokens
-        )
+        max_new = resolve_max_new(max_new_tokens, gen, self.max_new_tokens)
         if max_new >= self.cfg.max_seq_len:
             raise ValueError(
                 f"max_new_tokens={max_new} must be < max_seq_len={self.cfg.max_seq_len}"
@@ -565,15 +588,10 @@ class TpuBackend:
         t0 = time.time()
         # the segmented path only pays off when the budget spans multiple
         # segments (otherwise there is nothing to compact and the extra
-        # prefill/segment dispatches cost ~3% on a homogeneous batch); with
-        # temperature>0 compaction reshapes the batch mid-stream, which would
-        # silently change sampled outputs vs the one-shot path, so sampling
-        # always takes the one-shot program
-        continuous = (
-            self.continuous
-            and max_new > self.segment_tokens
-            and gen.temperature == 0.0
-        )
+        # prefill/segment dispatches cost ~3% on a homogeneous batch).
+        # Sampling is compaction-safe: per-row counter-based keys (see
+        # _make_parts) make each row's stream independent of batch position
+        continuous = self.continuous and max_new > self.segment_tokens
         for start in range(0, len(order), self.batch_size):
             group = order[start : start + self.batch_size]
             seed = self._next_seed(gen)
@@ -589,13 +607,15 @@ class TpuBackend:
             self.stats.batches += 1
             self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
             for row, i in enumerate(group):
-                results[i] = self._detok(out[row])
+                results[i] = self._detok(out[row], tuple(gen.eos_ids))
         self.stats.generate_seconds += time.time() - t0
         return results  # type: ignore[return-value]
 
-    def _detok(self, ids: np.ndarray) -> str:
+    def _detok(self, ids: np.ndarray, extra_eos: tuple[int, ...] = ()) -> str:
         self.stats.generated_tokens += int((ids != self.tok.pad_id).sum())
-        out = trim_to_eos(ids.tolist(), self.tok.eos_id, self.tok.pad_id)
+        out = trim_to_eos(
+            ids.tolist(), self.tok.eos_id, self.tok.pad_id, extra_eos
+        )
         return self.tok.decode(out).strip()
 
     def count_tokens(self, text: str) -> int:

@@ -15,6 +15,8 @@ hub access).
 from __future__ import annotations
 
 from ..core.config import GenerationConfig
+
+from .base import resolve_max_new
 from ..core.logging import get_logger
 from ..text.cleaning import clean_thinking_tokens
 
@@ -91,9 +93,7 @@ class HFBackend:
         config: GenerationConfig | None = None,
     ) -> list[str]:
         torch = self._torch
-        max_new = max_new_tokens or (
-            config.max_new_tokens if config else self.max_new_tokens
-        )
+        max_new = resolve_max_new(max_new_tokens, config, self.max_new_tokens)
         max_input = self.max_context - max_new  # ref :40-43
         if max_input <= 0:
             raise ValueError(

@@ -38,7 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
-from .base import fold_seed, left_pad_batch, trim_to_eos
+from .base import fold_seed, left_pad_batch, resolve_max_new, trim_to_eos
 from ..models.llama import (
     LlamaConfig,
     _embed_lookup,
@@ -419,9 +419,7 @@ class LongContextBackend:
         config: GenerationConfig | None = None,
     ) -> list[str]:
         gen = config or self.gen_cfg
-        max_new = max_new_tokens or (
-            config.max_new_tokens if config else self.max_new_tokens
-        )
+        max_new = resolve_max_new(max_new_tokens, gen, self.max_new_tokens)
         if max_new >= self.max_total_tokens:
             raise ValueError(
                 f"max_new_tokens={max_new} must be < "
@@ -469,7 +467,8 @@ class LongContextBackend:
             )
             for row, i in enumerate(group):
                 ids = trim_to_eos(
-                    out[row].tolist(), self.tok.eos_id, self.tok.pad_id
+                    out[row].tolist(), self.tok.eos_id, self.tok.pad_id,
+                    tuple(gen.eos_ids),
                 )
                 results[i] = self.tok.decode(ids).strip()
         return results  # type: ignore[return-value]

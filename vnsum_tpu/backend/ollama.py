@@ -16,6 +16,8 @@ import json
 from concurrent.futures import ThreadPoolExecutor
 
 from ..core.config import GenerationConfig
+
+from .base import resolve_max_new
 from ..core.faults import call_with_retries
 from ..core.logging import get_logger
 from ..text.cleaning import clean_thinking_tokens
@@ -133,9 +135,7 @@ class OllamaBackend:
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
     ) -> list[str]:
-        max_new = max_new_tokens or (
-            config.max_new_tokens if config else self.max_new_tokens
-        )
+        max_new = resolve_max_new(max_new_tokens, config, self.max_new_tokens)
         if len(prompts) == 1:
             return [self._one(prompts[0], max_new, config)]
         with ThreadPoolExecutor(max_workers=self.concurrency) as pool:

@@ -33,7 +33,9 @@ APPROACHES: tuple[str, ...] = (
 class GenerationConfig:
     """Decoding parameters for one backend.generate() call."""
 
-    max_new_tokens: int = 1024
+    # None = inherit the backend's constructor default; a config passed only
+    # to set temperature/eos must not silently override the decode budget
+    max_new_tokens: int | None = None
     temperature: float = 0.0  # 0.0 => greedy (ref: run_summarization.py:44)
     top_k: int = 0            # 0 => disabled
     top_p: float = 1.0
@@ -110,10 +112,20 @@ class PipelineConfig:
     batch_size: int = 8
     tokenizer: str = "byte"  # byte | hf:<name-or-path>
     mesh_shape: dict[str, int] = field(default_factory=dict)
+    # opt-in: when mesh_shape needs more devices than the default platform
+    # has, rebuild the mesh on host CPU devices (tests, dry runs, artifact
+    # scripts). Off by default so a production TPU run with an oversized
+    # --mesh fails loudly instead of silently running ~100x slower on CPU
+    allow_cpu_mesh: bool = False
     # ring-attention prefill + seq-sharded decode (backend/long_context.py):
     # prompts run UN-truncated up to seq_axis × the one-chip limit; requires
     # backend=tpu and a mesh with a seq axis > 1
     long_context: bool = False
+    # int8-quantize the long-context prefill KV cache. LOSSY (per-position
+    # int8 round-trip on cached K/V) but halves ring-decode HBM traffic —
+    # the dominant cost of long-context decode. Off by default because
+    # `quantize` alone promises exact weight-only quantization
+    long_context_quantize_kv: bool = False
     # int8 weight-only quantization (per-output-channel scales — exact
     # w.r.t. the quantized weights; models/quant.py). The engine's decode is
     # weight-bandwidth-bound, so this is most of the single-chip speedup
@@ -130,6 +142,12 @@ class PipelineConfig:
         if self.approach not in APPROACHES:
             raise ValueError(
                 f"unknown approach {self.approach!r}; expected one of {APPROACHES}"
+            )
+        if self.long_context_quantize_kv and not self.long_context:
+            raise ValueError(
+                "long_context_quantize_kv requires long_context=True — the "
+                "one-chip engine ignores it, so the run would claim an int8 "
+                "prefill cache while using the exact one"
             )
         if self.chunk_overlap >= self.chunk_size:
             raise ValueError("chunk_overlap must be smaller than chunk_size")

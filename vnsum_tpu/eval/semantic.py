@@ -7,6 +7,7 @@ scraping step (contrast run_full_evaluation_pipeline.py:729-784).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 from pathlib import Path
 
@@ -56,11 +57,18 @@ class SemanticEvaluator:
         use_stemmer: bool = True,
         include_llm_eval: bool = False,
         llm_judge=None,
+        tracer=None,
     ) -> None:
         self.embedder = embedding_model or EmbeddingModel()
         self.rouge = RougeScorer(["rouge1", "rouge2", "rougeL"], use_stemmer)
         self.include_llm_eval = include_llm_eval
         self.llm_judge = llm_judge
+        self.tracer = tracer
+
+    def _span(self, name: str):
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name)
 
     def evaluate_pairs(
         self,
@@ -75,16 +83,19 @@ class SemanticEvaluator:
         ref_texts = [references[f] for f in common]
 
         # batched on-device embedding passes (one per side, not per pair)
-        gen_emb = self.embedder.sentence_embeddings(gen_texts)
-        ref_emb = self.embedder.sentence_embeddings(ref_texts)
-        sims = cosine_similarities(gen_emb, ref_emb)
+        with self._span("embed"):
+            gen_emb = self.embedder.sentence_embeddings(gen_texts)
+            ref_emb = self.embedder.sentence_embeddings(ref_texts)
+            sims = cosine_similarities(gen_emb, ref_emb)
 
-        bert = bert_scores(self.embedder, gen_texts, ref_texts)
+        with self._span("bertscore"):
+            bert = bert_scores(self.embedder, gen_texts, ref_texts)
 
         detailed = []
         r1, r2, rl = [], [], []
         for fname, g, r, sim in zip(common, gen_texts, ref_texts, sims):
-            scores = self.rouge.score(r, g)
+            with self._span("rouge"):
+                scores = self.rouge.score(r, g)
             r1.append(scores["rouge1"].fmeasure)
             r2.append(scores["rouge2"].fmeasure)
             rl.append(scores["rougeL"].fmeasure)

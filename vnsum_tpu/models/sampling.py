@@ -37,3 +37,23 @@ def sample_logits(
         logits = jnp.where(logits < cutoff, jnp.finfo(jnp.float32).min, logits)
 
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_logits_rows(
+    logits: jax.Array,      # [B, V] float32
+    keys: jax.Array,        # [B] PRNG keys, one per row
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Per-row-keyed sampling: row i draws only from keys[i], so a row's
+    sampled stream is invariant to its position in the batch. This is what
+    lets the continuous scheduler compact a sampled batch mid-decode without
+    changing any surviving row's output (engine.py derives keys[i] from
+    (seed, row_uid, step) — counter-based, like per-request generators in
+    continuous-batching servers)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.vmap(
+        lambda l, k: sample_logits(l[None], k, temperature, top_k, top_p)[0]
+    )(logits, keys)

@@ -36,12 +36,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--mesh", default="", help='device mesh, e.g. "data=2,model=4"'
     )
     p.add_argument(
+        "--allow-cpu-mesh", action="store_true",
+        help="when --mesh needs more devices than the default platform "
+        "has, rebuild it on host CPU devices instead of failing (tests / "
+        "dry runs; ~100x slower than TPU — never for production)",
+    )
+    p.add_argument(
         "--quantize", action="store_true",
         help="int8 weight-only quantization for the tpu backend (halves "
         "decode HBM traffic). The one-chip engine's KV cache quantizes "
         "automatically whenever its Pallas kernels are active (independent "
         "of this flag); the long-context prefill cache stays exact — its "
-        "lossy int8 mode is API-only (LongContextBackend(quantize_kv=True))",
+        "lossy int8 mode is opt-in via --quantize-kv-long",
+    )
+    p.add_argument(
+        "--quantize-kv-long", action="store_true",
+        help="int8-quantize the long-context prefill KV cache (halves "
+        "ring-decode HBM traffic per step). LOSSY: cached K/V round-trip "
+        "through per-(position,head) int8, so logits drift slightly vs the "
+        "exact cache — greedy summaries can differ in late tokens. "
+        "Measured drift is small (tests/test_backend_long_context.py "
+        "quantize_kv parity bounds); quality-gate runs should A/B it",
     )
     p.add_argument(
         "--long-context", action="store_true",
@@ -117,7 +132,9 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         batch_size=args.batch_size,
         tokenizer=args.tokenizer,
         mesh_shape=mesh_shape,
+        allow_cpu_mesh=args.allow_cpu_mesh,
         long_context=args.long_context,
+        long_context_quantize_kv=args.quantize_kv_long,
         quantize=args.quantize,
         tree_json_path=args.tree_json,
         max_depth=args.max_depth,

@@ -82,12 +82,29 @@ class PipelineRunner:
                 # the axon plugin keeps TPU default regardless of
                 # JAX_PLATFORMS; when the requested mesh needs more devices
                 # than the default platform has but the host CPU pool fits
-                # (tests, dry runs), build the mesh there instead
+                # (tests, dry runs), build the mesh there instead — ONLY
+                # with explicit opt-in, so a production mesh typo fails
+                # loudly instead of silently running the run on CPU
                 platform = None
                 need = math.prod(v for v in cfg.mesh_shape.values() if v > 0)
-                if need > len(jax.devices()) and need <= len(jax.devices("cpu")):
+                if need > len(jax.devices()):
+                    if not cfg.allow_cpu_mesh:
+                        raise RuntimeError(
+                            f"mesh {cfg.mesh_shape} needs {need} devices but "
+                            f"the default platform has {len(jax.devices())}; "
+                            "set allow_cpu_mesh=True (or shrink the mesh) if "
+                            "a host-CPU mesh is intended"
+                        )
+                    if need > len(jax.devices("cpu")):
+                        raise RuntimeError(
+                            f"mesh {cfg.mesh_shape} needs {need} devices; "
+                            f"host CPU pool has {len(jax.devices('cpu'))} "
+                            "(set XLA_FLAGS=--xla_force_host_platform_"
+                            "device_count)"
+                        )
                     logger.info(
-                        "mesh %s exceeds default platform; using cpu devices",
+                        "mesh %s exceeds default platform; using cpu devices "
+                        "(allow_cpu_mesh)",
                         cfg.mesh_shape,
                     )
                     platform = "cpu"
@@ -114,10 +131,10 @@ class PipelineRunner:
                         else None
                     ),
                     quantize=cfg.quantize,
-                    # cfg.quantize promises weight-only (exact)
-                    # quantization; int8 prefill-cache quantization is
-                    # lossy, so it stays API-opt-in
-                    quantize_kv=False,
+                    # cfg.quantize alone promises weight-only (exact)
+                    # quantization; the lossy int8 prefill cache needs its
+                    # own explicit opt-in (--quantize-kv-long)
+                    quantize_kv=cfg.long_context_quantize_kv,
                 )
             return get_backend(
                 "tpu",
@@ -347,15 +364,16 @@ class PipelineRunner:
         if embedder is None:
             from ..eval import EmbeddingModel
 
-            if cfg.evaluation.embedding_dir:
-                embedder = EmbeddingModel.from_hf(
-                    cfg.evaluation.embedding_dir,
-                    batch_size=cfg.evaluation.bert_batch_size,
-                )
-            else:
-                embedder = EmbeddingModel(
-                    batch_size=cfg.evaluation.bert_batch_size
-                )
+            with self.tracer.span("embedder_init"):
+                if cfg.evaluation.embedding_dir:
+                    embedder = EmbeddingModel.from_hf(
+                        cfg.evaluation.embedding_dir,
+                        batch_size=cfg.evaluation.bert_batch_size,
+                    )
+                else:
+                    embedder = EmbeddingModel(
+                        batch_size=cfg.evaluation.bert_batch_size
+                    )
             self.embedding_model = embedder  # reuse across the model sweep
         judge = None
         if cfg.evaluation.include_llm_eval:
@@ -364,6 +382,7 @@ class PipelineRunner:
             embedding_model=embedder,
             include_llm_eval=judge is not None,
             llm_judge=judge,
+            tracer=self.tracer,
         )
         out_path = (
             Path(cfg.results_dir) / f"{model_name_safe(model)}_results.json"
