@@ -15,6 +15,34 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import pytest  # noqa: E402
 
+# Heavy JAX-compile modules: every test in these files traces + compiles real
+# model programs, which dominates wall-clock on a 1-core host (full suite
+# >10 min there). The remaining files are the FAST tier — host logic plus
+# tiny-encoder compiles — and finish in ~2.5 min:
+#   python -m pytest -m "not slow"
+# The full hermetic suite stays the CI default (plain `pytest`).
+_SLOW_MODULES = {
+    "test_backend_continuous",
+    "test_backend_engine",
+    "test_backend_long_context",
+    "test_graft_entry",
+    "test_model_convert",
+    "test_model_llama",
+    "test_model_quant",
+    "test_ops_decode",
+    "test_ops_flash",
+    "test_parallel_distributed",
+    "test_parallel_train",
+    "test_pipeline_weights_dir",
+    "test_train_checkpoint",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session", autouse=True)
 def _cpu_default_device():

@@ -310,3 +310,25 @@ def test_sampled_batches_draw_fresh_randomness():
     # a different GenerationConfig.seed changes the stream (knob is honored)
     c = fresh()
     assert c.generate(["một văn bản"], config=gen.with_(seed=99)) != first
+
+
+def test_sampling_restricted_to_tokenizer_vocab():
+    """A model head larger than the tokenizer vocab must never emit ids the
+    tokenizer cannot decode (they would vanish at detok, yielding empty
+    summaries — round-3 bench regression)."""
+    from vnsum_tpu.backend.engine import TpuBackend
+
+    cfg = tiny_llama(vocab_size=2048)  # model vocab >> byte-tokenizer vocab
+    be = TpuBackend(
+        model_config=cfg, tokenizer="byte", batch_size=2, max_new_tokens=16,
+        seed=0, continuous=False,
+    )
+    outs = be.generate(
+        ["văn bản", "hai"],
+        config=GenerationConfig(temperature=1.0, seed=9),
+    )
+    # sampled ids stay in [0, 256) — raw bytes — so EVERY row decodes to
+    # its full 16-byte stream (an undecodable id anywhere would shorten or
+    # empty it; whitespace-only streams are the only (vanishing) exception)
+    assert all(o for o in outs), outs
+    assert all(len(o.encode("utf-8", "ignore")) >= 8 for o in outs), outs

@@ -165,3 +165,70 @@ def test_sharded_checkpoint_with_index(tmp_path, hf_model, converted):
         np.asarray(params["layers"]["w_down"]),
         atol=1e-6,
     )
+
+
+def test_save_hf_checkpoint_roundtrip(tmp_path, converted):
+    """save_hf_checkpoint is the exact inverse of load_hf_checkpoint: a
+    params tree exported to sharded HF safetensors and loaded back must be
+    bit-identical (modulo the bf16 storage dtype) and produce identical
+    prefill logits. This pair is how the 3B runbook artifact proves the
+    converter at real scale without the real weights."""
+    import jax
+
+    from vnsum_tpu.models.convert import save_hf_checkpoint
+
+    cfg, params = converted
+    out = tmp_path / "export"
+    index = save_hf_checkpoint(params, cfg, str(out), shard_layers=1)
+    # sharding actually happened: 2 layer shards + 1 head shard
+    assert len(set(index["weight_map"].values())) == 3
+    cfg2, params2 = load_hf_checkpoint(str(out), dtype=jnp.float32)
+    assert cfg2.dim == cfg.dim and cfg2.n_layers == cfg.n_layers
+    assert cfg2.tie_embeddings == cfg.tie_embeddings
+
+    def max_diff(a, b):
+        return max(
+            float(jnp.max(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32))))
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+
+    # bf16 storage: exported tensors round through bfloat16 once
+    bf = jax.tree.map(lambda x: x.astype(jnp.bfloat16).astype(jnp.float32), params)
+    assert max_diff(bf, params2) == 0.0
+
+    tokens = np.arange(12, dtype=np.int32).reshape(1, 12) % cfg.vocab_size
+    S = 16
+    pad = np.asarray([S - 12], np.int32)
+    toks = np.full((1, S), 0, np.int32)
+    toks[0, 4:] = tokens
+    def logits_of(p):
+        cache = init_kv_cache(cfg, 1, S)
+        out, _ = forward(
+            p, cfg, jnp.asarray(toks), prefill_positions(jnp.asarray(pad), S),
+            cache, 0, prefill_attention_mask(jnp.asarray(pad), S, S),
+            last_only=True,
+        )
+        return np.asarray(out)
+
+    np.testing.assert_array_equal(logits_of(bf), logits_of(params2))
+
+
+def test_save_hf_checkpoint_untied(tmp_path):
+    """Untied lm_head round-trips through the [vocab, dim] HF layout."""
+    import jax
+
+    from vnsum_tpu.models import init_params
+    from vnsum_tpu.models.convert import save_hf_checkpoint
+    from vnsum_tpu.models.llama import tiny_llama
+
+    cfg = tiny_llama(tie_embeddings=False)
+    params = init_params(jax.random.key(0), cfg)
+    out = tmp_path / "export"
+    save_hf_checkpoint(params, cfg, str(out))
+    cfg2, params2 = load_hf_checkpoint(str(out), dtype=jnp.float32)
+    assert not cfg2.tie_embeddings
+    got = np.asarray(params2["lm_head"], np.float32)
+    want = np.asarray(
+        jnp.asarray(params["lm_head"], jnp.bfloat16).astype(jnp.float32)
+    )
+    np.testing.assert_array_equal(got, want)

@@ -75,6 +75,19 @@ def trim_to_eos(
     return out
 
 
+def decodable_vocab_limit(tok, model_vocab_size: int) -> int:
+    """Sampling range that can actually become text: the model head may be
+    larger than the tokenizer (random-init 128k-vocab model + byte tokenizer
+    in benches/tests), and a tokenizer may carry padded/special ids its
+    decode() drops (ByteTokenizer ids >= 256). Sampling outside this range
+    yields silently-vanishing tokens and empty summaries. Real HF
+    tokenizers set decodable == vocab == model head, making this a no-op."""
+    tok_limit = getattr(
+        tok, "decodable_vocab_size", getattr(tok, "vocab_size", None)
+    )
+    return min(model_vocab_size, tok_limit or model_vocab_size)
+
+
 def resolve_max_new(
     max_new_tokens: int | None, config, backend_default: int
 ) -> int:

@@ -26,7 +26,13 @@ import numpy as np
 
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
-from .base import fold_seed, left_pad_batch, resolve_max_new, trim_to_eos
+from .base import (
+    decodable_vocab_limit,
+    fold_seed,
+    left_pad_batch,
+    resolve_max_new,
+    trim_to_eos,
+)
 from ..core.profiling import annotate
 from ..models.llama import (
     LlamaConfig,
@@ -95,6 +101,9 @@ class TpuBackend:
         min_batch: int = 8,
         interpret: bool = False,
     ) -> None:
+        from ..core.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()  # per-bucket programs amortize on disk
         self.cfg = model_config or llama32_3b()
         self.interpret = bool(interpret)
         # Pallas flash prefill: "auto" enables it on real TPU (the kernel
@@ -198,6 +207,8 @@ class TpuBackend:
         eos = jnp.asarray(
             list(gen.eos_ids) or [self.tok.eos_id], dtype=jnp.int32
         )
+        # never sample a token the tokenizer cannot render as text
+        vocab_limit = decodable_vocab_limit(self.tok, cfg.vocab_size)
         pad_id = self.tok.pad_id
         use_flash, use_flash_decode = self._decode_settings(S, C)
         mesh = self.mesh
@@ -251,7 +262,8 @@ class TpuBackend:
                 lambda u: jax.random.fold_in(jax.random.fold_in(base, u), 0)
             )(uids0)
             first = sample_logits_rows(
-                logits[:, -1], keys0, gen.temperature, gen.top_k, gen.top_p
+                logits[:, -1, :vocab_limit], keys0,
+                gen.temperature, gen.top_k, gen.top_p,
             )
             # all-pad dummy rows (batch bucketing filler) start done, else
             # their garbage decode would keep the early exit from firing
@@ -307,7 +319,7 @@ class TpuBackend:
                     )
                 )(uids)
                 nxt = sample_logits_rows(
-                    logits[:, -1], step_keys,
+                    logits[:, -1, :vocab_limit], step_keys,
                     gen.temperature, gen.top_k, gen.top_p,
                 )
                 return (t + 1, nxt, cache, done, out)
@@ -357,7 +369,8 @@ class TpuBackend:
         ns = lambda spec: NamedSharding(self.mesh, spec)
         return (
             param_shardings(
-                self.mesh, self.cfg.tie_embeddings, is_quantized(self.params)
+                self.mesh, self.cfg.tie_embeddings, is_quantized(self.params),
+                qk_norm=self.cfg.qk_norm,
             ),
             ns(P("data", None)),
             ns(P("data")),

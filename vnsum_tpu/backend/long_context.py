@@ -38,7 +38,13 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
-from .base import fold_seed, left_pad_batch, resolve_max_new, trim_to_eos
+from .base import (
+    decodable_vocab_limit,
+    fold_seed,
+    left_pad_batch,
+    resolve_max_new,
+    trim_to_eos,
+)
 from ..models.llama import (
     LlamaConfig,
     _embed_lookup,
@@ -264,6 +270,7 @@ def generate_long_tokens(
     top_p: float = 1.0,
     seed: int = 0,
     quantize_kv: bool = False,
+    vocab_limit: int = 0,
 ) -> jax.Array:
     """Traceable end-to-end long-context generation; returns [B, max_new].
 
@@ -273,6 +280,9 @@ def generate_long_tokens(
     the freed HBM doubles the context that fits)."""
     B, S = tokens.shape
     eos = jnp.asarray(list(eos_ids), dtype=jnp.int32)
+    # 0 = full model vocab; a smaller tokenizer vocab restricts sampling to
+    # decodable ids (same rationale as engine.py's vocab_limit)
+    V = vocab_limit or None
 
     last_logits, prefill_cache = long_prefill(
         params, cfg, tokens, pad_lens, mesh
@@ -281,7 +291,7 @@ def generate_long_tokens(
         prefill_cache = quantize_prefill_cache(prefill_cache)
     key = jax.random.key(seed)
     key, sub = jax.random.split(key)
-    first = sample_logits(last_logits, sub, temperature, top_k, top_p)
+    first = sample_logits(last_logits[:, :V], sub, temperature, top_k, top_p)
     done0 = pad_lens == S  # all-pad filler rows start done
 
     attention = make_long_decode_attention(
@@ -309,7 +319,9 @@ def generate_long_tokens(
             stacked_attention_fn=lambda q, c, li: attention(q, c, li, t),
         )
         key, sub = jax.random.split(key)
-        nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+        nxt = sample_logits(
+            logits[:, -1, :V], sub, temperature, top_k, top_p
+        )
         return (t + 1, nxt, cache, done, key, out)
 
     *_, out = jax.lax.while_loop(
@@ -344,6 +356,9 @@ class LongContextBackend:
     ) -> None:
         from ..models.llama import init_params, llama32_3b
 
+        from ..core.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         if mesh is None or AXES.seq not in mesh.shape:
             raise ValueError(
                 "LongContextBackend needs a mesh with a 'seq' axis — that "
@@ -489,6 +504,9 @@ class LongContextBackend:
                     temperature=gen.temperature, top_k=gen.top_k,
                     top_p=gen.top_p, seed=seed,
                     quantize_kv=self.quantize_kv,
+                    vocab_limit=decodable_vocab_limit(
+                        self.tok, self.cfg.vocab_size
+                    ),
                 )
 
             self._fns[key] = jax.jit(
@@ -497,6 +515,7 @@ class LongContextBackend:
                     param_shardings(
                         self.mesh, self.cfg.tie_embeddings,
                         is_quantized(self.params),
+                        qk_norm=self.cfg.qk_norm,
                     ),
                     ns(P(AXES.data, AXES.seq)),
                     ns(P(AXES.data)),

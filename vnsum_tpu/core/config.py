@@ -110,6 +110,12 @@ class PipelineConfig:
 
     # engine
     batch_size: int = 8
+    # documents submitted to the strategy per round; 0 = auto (4x batch_size).
+    # Bigger groups pack map/collapse/reduce calls into fuller device batches
+    # (a group of batch_size docs leaves reduce rounds running B=2/B=4
+    # half-empty dispatches — each a fresh bucket compile); the cost is
+    # coarser resume granularity (summaries write per group)
+    doc_group_size: int = 0
     tokenizer: str = "byte"  # byte | hf:<name-or-path>
     mesh_shape: dict[str, int] = field(default_factory=dict)
     # opt-in: when mesh_shape needs more devices than the default platform

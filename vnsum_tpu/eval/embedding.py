@@ -43,6 +43,9 @@ class EmbeddingModel:
         batch_size: int = 32,
         seed: int = 0,
     ) -> None:
+        from ..core.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         self.cfg = config or minilm_like()
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
         self.max_len = max_len or self.cfg.max_len
@@ -163,13 +166,21 @@ def bert_scores(
     for start in range(0, len(candidates), bs):
         cands = candidates[start : start + bs]
         refs = references[start : start + bs]
+        n = len(cands)
+        # pad the trailing partial chunk to the full batch size so
+        # _greedy_match compiles exactly ONE shape per corpus (a second
+        # trace of the [n, S, S] einsum costs more than the padded rows)
+        cands = cands + [""] * (bs - n)
+        refs = refs + [""] * (bs - n)
         c_embs, c_mask = model.token_embeddings(cands)
         r_embs, r_mask = model.token_embeddings(refs)
         P, R = _greedy_match(
             jnp.asarray(c_embs), jnp.asarray(c_mask),
             jnp.asarray(r_embs), jnp.asarray(r_mask),
         )
-        for p, r in zip(np.asarray(P).tolist(), np.asarray(R).tolist()):
+        for p, r in zip(
+            np.asarray(P)[:n].tolist(), np.asarray(R)[:n].tolist()
+        ):
             f1 = 2 * p * r / (p + r) if (p + r) else 0.0
             out.append(BertScore(p, r, f1))
     return out

@@ -7,6 +7,8 @@ from .llama import (
     is_quantized_cache,
     llama32_1b,
     llama32_3b,
+    qwen3_0p6b,
+    qwen3_8b,
     tiny_llama,
 )
 from .sampling import sample_logits
@@ -18,6 +20,10 @@ MODEL_REGISTRY = {
     "llama3.2-3b": llama32_3b,
     "llama3.2:1b": llama32_1b,
     "llama3.2-1b": llama32_1b,
+    "qwen3:8b": qwen3_8b,
+    "qwen3-8b": qwen3_8b,
+    "qwen3:0.6b": qwen3_0p6b,
+    "qwen3-0.6b": qwen3_0p6b,
     "tiny": tiny_llama,
 }
 
@@ -28,6 +34,8 @@ __all__ = [
     "init_params",
     "llama32_1b",
     "llama32_3b",
+    "qwen3_0p6b",
+    "qwen3_8b",
     "tiny_llama",
     "sample_logits",
 ]

@@ -39,6 +39,19 @@ _LAYER_KEYS: dict[str, str] = {
     "post_attention_layernorm.weight": "mlp_norm",
 }
 
+# Qwen3 adds per-head Q/K RMSNorms (same HF naming in Qwen3* checkpoints)
+_QK_NORM_KEYS: dict[str, str] = {
+    "self_attn.q_norm.weight": "q_norm",
+    "self_attn.k_norm.weight": "k_norm",
+}
+
+
+def _layer_keys(cfg: LlamaConfig) -> dict[str, str]:
+    keys = dict(_LAYER_KEYS)
+    if cfg.qk_norm:
+        keys.update(_QK_NORM_KEYS)
+    return keys
+
 
 def config_from_hf(hf: Mapping[str, Any], **overrides) -> LlamaConfig:
     """Build a :class:`LlamaConfig` from a parsed HF ``config.json`` dict."""
@@ -47,7 +60,9 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> LlamaConfig:
     head_dim = hf.get("head_dim") or (
         hf["hidden_size"] // hf["num_attention_heads"]
     )
+    model_type = hf.get("model_type", "llama")
     kw: dict[str, Any] = dict(
+        qk_norm=model_type.startswith("qwen3"),
         vocab_size=hf["vocab_size"],
         dim=hf["hidden_size"],
         n_layers=hf["num_hidden_layers"],
@@ -102,9 +117,10 @@ def convert_hf_state_dict(
             return arr.T
         return arr  # norms, embed
 
-    layers: dict[str, list[np.ndarray]] = {k: [] for k in _LAYER_KEYS.values()}
+    layer_keys = _layer_keys(cfg)
+    layers: dict[str, list[np.ndarray]] = {k: [] for k in layer_keys.values()}
     for li in range(cfg.n_layers):
-        for hf_key, ours in _LAYER_KEYS.items():
+        for hf_key, ours in layer_keys.items():
             raw = np.asarray(get(f"model.layers.{li}.{hf_key}"))
             layers[ours].append(conv(ours, raw))
 
@@ -158,11 +174,129 @@ def _safetensors_getter(model_dir: str) -> Callable[[str], np.ndarray]:
 def load_hf_checkpoint(
     model_dir: str, dtype=None, **config_overrides
 ) -> tuple[LlamaConfig, dict]:
-    """Load ``config.json`` + safetensors shards from a local HF model dir."""
+    """Load ``config.json`` + safetensors shards from a local HF model dir.
+
+    ``dtype`` applies to BOTH the converted params and the returned config —
+    the config's dtype drives KV-cache/activation dtypes downstream, and a
+    float32 param tree against a bfloat16 cache is a dispatch-time error."""
+    if dtype is not None:
+        config_overrides.setdefault("dtype", dtype)
     with open(os.path.join(model_dir, "config.json")) as f:
         cfg = config_from_hf(json.load(f), **config_overrides)
     params = convert_hf_state_dict(_safetensors_getter(model_dir), cfg, dtype)
     return cfg, params
+
+
+def save_hf_checkpoint(
+    params: dict,
+    cfg: LlamaConfig,
+    out_dir: str,
+    shard_layers: int = 8,
+) -> dict:
+    """Export a stacked pytree back to HF Llama format (the exact inverse of
+    :func:`load_hf_checkpoint`): ``config.json`` + sharded ``*.safetensors``
+    + ``model.safetensors.index.json``.
+
+    Round-tripping through this pair is how the 3B runbook artifact proves
+    the converter at real scale without network access to the real weights
+    (the reference simply downloads them, runners/run_summarization.py:54-62).
+    Returns the index dict that was written."""
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    H, KV, hd, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.dim
+    np_bf16 = ml_dtypes.bfloat16
+
+    def to_np(x) -> np.ndarray:
+        return np.asarray(x).astype(np_bf16)
+
+    def deconv(ours: str, arr: np.ndarray) -> np.ndarray:
+        # inverse of convert_hf_state_dict.conv: back to HF [out, in] layout
+        if ours == "wq":
+            return arr.reshape(D, H * hd).T
+        if ours in ("wk", "wv"):
+            return arr.reshape(D, KV * hd).T
+        if ours == "wo":
+            return arr.reshape(H * hd, D).T
+        if ours in ("w_gate", "w_up", "w_down"):
+            return arr.T
+        return arr  # norms
+
+    hf_cfg = {
+        "architectures": (
+            ["Qwen3ForCausalLM"] if cfg.qk_norm else ["LlamaForCausalLM"]
+        ),
+        "model_type": "qwen3" if cfg.qk_norm else "llama",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.dim,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.intermediate,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.norm_eps,
+        "max_position_embeddings": cfg.max_seq_len,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "torch_dtype": "bfloat16",
+    }
+    if cfg.use_llama3_rope_scaling:
+        hf_cfg["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": cfg.rope_scale_factor,
+            "low_freq_factor": cfg.rope_low_freq_factor,
+            "high_freq_factor": cfg.rope_high_freq_factor,
+            "original_max_position_embeddings": cfg.rope_original_max_len,
+        }
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+
+    ours_to_hf = {v: k for k, v in _layer_keys(cfg).items()}
+    weight_map: dict[str, str] = {}
+    shard_id, n_shards = 0, (cfg.n_layers + shard_layers - 1) // shard_layers
+    n_shards += 1  # embeddings/norm shard
+    total_bytes = 0
+
+    def write_shard(tensors: dict[str, np.ndarray]) -> None:
+        nonlocal shard_id, total_bytes
+        name = f"model-{shard_id + 1:05d}-of-{n_shards:05d}.safetensors"
+        # safetensors writes the raw buffer of ml_dtypes.bfloat16 arrays —
+        # strides are IGNORED, so any transposed/F-order view would be
+        # silently saved scrambled; force C-order explicitly
+        tensors = {k: np.ascontiguousarray(v) for k, v in tensors.items()}
+        save_file(tensors, os.path.join(out_dir, name))
+        for k, v in tensors.items():
+            weight_map[k] = name
+            total_bytes += v.nbytes
+        shard_id += 1
+
+    # per-layer shards, materializing one layer group at a time so host RSS
+    # stays ~shard-sized even for multi-GB checkpoints
+    for start in range(0, cfg.n_layers, shard_layers):
+        tensors = {}
+        for li in range(start, min(start + shard_layers, cfg.n_layers)):
+            for ours, stacked in params["layers"].items():
+                tensors[f"model.layers.{li}.{ours_to_hf[ours]}"] = deconv(
+                    ours, to_np(stacked[li])
+                )
+        write_shard(tensors)
+
+    head = {
+        "model.embed_tokens.weight": to_np(params["embed"]),
+        "model.norm.weight": to_np(params["final_norm"]),
+    }
+    if not cfg.tie_embeddings:
+        head["lm_head.weight"] = to_np(params["lm_head"]).T
+    write_shard(head)
+
+    index = {
+        "metadata": {"total_size": total_bytes},
+        "weight_map": weight_map,
+    }
+    with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+        json.dump(index, f)
+    return index
 
 
 def convert_torch_model(model, cfg: LlamaConfig, dtype=None) -> dict:

@@ -43,6 +43,12 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 16_384
     tie_embeddings: bool = True
+    # Qwen3-style per-head RMSNorm on Q/K before RoPE — the one structural
+    # delta between the Llama and Qwen3 decoder stacks; everything else
+    # (GQA, SwiGLU, pre-norm residuals) is shared, so both families run
+    # through this module (reference sweeps qwen3:8b alongside llama3.2:3b,
+    # run_full_evaluation_pipeline.py:960-962)
+    qk_norm: bool = False
     dtype: Any = field(default=jnp.bfloat16)
 
     @property
@@ -58,6 +64,28 @@ def llama32_1b(**kw) -> LlamaConfig:
     base = dict(
         dim=2048, n_layers=16, n_heads=32, n_kv_heads=8, head_dim=64,
         intermediate=8192,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def qwen3_8b(**kw) -> LlamaConfig:
+    base = dict(
+        vocab_size=151_936, dim=4096, n_layers=36, n_heads=32, n_kv_heads=8,
+        head_dim=128, intermediate=12_288, rope_theta=1_000_000.0,
+        use_llama3_rope_scaling=False, norm_eps=1e-6, max_seq_len=32_768,
+        tie_embeddings=False, qk_norm=True,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def qwen3_0p6b(**kw) -> LlamaConfig:
+    base = dict(
+        vocab_size=151_936, dim=1024, n_layers=28, n_heads=16, n_kv_heads=8,
+        head_dim=128, intermediate=3072, rope_theta=1_000_000.0,
+        use_llama3_rope_scaling=False, norm_eps=1e-6, max_seq_len=32_768,
+        tie_embeddings=True, qk_norm=True,
     )
     base.update(kw)
     return LlamaConfig(**base)
@@ -104,6 +132,9 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
         },
         "final_norm": jnp.ones((D,), cfg.dtype),
     }
+    if cfg.qk_norm:
+        params["layers"]["q_norm"] = jnp.ones((L, hd), cfg.dtype)
+        params["layers"]["k_norm"] = jnp.ones((L, hd), cfg.dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = norm((D, cfg.vocab_size), next(keys))
     return params
@@ -288,6 +319,10 @@ def _block(
     q = _proj("bsd,dhk->bshk", h, lp["wq"])
     k = _proj("bsd,dhk->bshk", h, lp["wk"])
     v = _proj("bsd,dhk->bshk", h, lp["wv"])
+    if cfg.qk_norm:
+        # Qwen3: RMSNorm over each head's hd dim before RoPE
+        q = _rmsnorm(q, lp["q_norm"], cfg.norm_eps)
+        k = _rmsnorm(k, lp["k_norm"], cfg.norm_eps)
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
 
@@ -422,6 +457,10 @@ def cache_free_block(x, lp, cos, sin, cfg: LlamaConfig, attention_fn):
     q = _proj("bsd,dhk->bshk", h, lp["wq"])
     k = _proj("bsd,dhk->bshk", h, lp["wk"])
     v = _proj("bsd,dhk->bshk", h, lp["wv"])
+    if cfg.qk_norm:
+        # Qwen3: RMSNorm over each head's hd dim before RoPE
+        q = _rmsnorm(q, lp["q_norm"], cfg.norm_eps)
+        k = _rmsnorm(k, lp["k_norm"], cfg.norm_eps)
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
     attn = attention_fn(q, k, v, cfg.q_per_kv)

@@ -19,7 +19,10 @@ _D, _M, _F = AXES.data, AXES.model, AXES.fsdp
 
 
 def param_specs(
-    tie_embeddings: bool = True, quantized: bool = False, fsdp: bool = False
+    tie_embeddings: bool = True,
+    quantized: bool = False,
+    fsdp: bool = False,
+    qk_norm: bool = False,
 ) -> dict[str, Any]:
     """PartitionSpec pytree matching models.llama param structure.
 
@@ -50,6 +53,10 @@ def param_specs(
         },
         "final_norm": P(None),
     }
+    if qk_norm:
+        # per-head Q/K norms [L, hd]: tiny, replicated over model
+        specs["layers"]["q_norm"] = P(L, None)
+        specs["layers"]["k_norm"] = P(L, None)
     if not tie_embeddings:
         specs["lm_head"] = P(None, _M)       # [D, V]
     if quantized:
@@ -93,10 +100,11 @@ def param_shardings(
     tie_embeddings: bool = True,
     quantized: bool = False,
     fsdp: bool = False,
+    qk_norm: bool = False,
 ) -> dict[str, Any]:
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(tie_embeddings, quantized, fsdp),
+        param_specs(tie_embeddings, quantized, fsdp, qk_norm),
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -110,7 +118,8 @@ def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True) -> Any:
     from ..models.quant import is_quantized
 
     quantized = is_quantized(params)
-    specs = param_specs(tie_embeddings, quantized)
+    qk_norm = "q_norm" in params["layers"]
+    specs = param_specs(tie_embeddings, quantized, qk_norm=qk_norm)
 
     def check(leaf, spec):
         for dim, axis in enumerate(spec):
@@ -125,5 +134,5 @@ def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True) -> Any:
                 )
 
     jax.tree.map(check, params, specs, is_leaf=lambda x: isinstance(x, P))
-    shardings = param_shardings(mesh, tie_embeddings, quantized)
+    shardings = param_shardings(mesh, tie_embeddings, quantized, qk_norm=qk_norm)
     return jax.tree.map(jax.device_put, params, shardings)

@@ -270,8 +270,9 @@ class PipelineRunner:
         )
 
         # submit documents in batches; each batch's map/collapse rounds share
-        # device batches inside the strategy
-        group_size = max(cfg.batch_size, 1)
+        # device batches inside the strategy. Groups default to 4x the engine
+        # batch so collapse/reduce rounds still fill whole dispatches
+        group_size = cfg.doc_group_size or 4 * max(cfg.batch_size, 1)
         for start in range(0, len(pending), group_size):
             group = pending[start : start + group_size]
             batch_t0 = time.time()

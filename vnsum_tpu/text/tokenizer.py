@@ -48,6 +48,10 @@ class ByteTokenizer:
         self.eos_id = 257
         self.pad_id = 258
         self.vocab_size = 384  # 259 rounded up to a multiple of 128
+        # ids that decode() can render as text: the 256 raw bytes. BOS/EOS/
+        # PAD terminate or vanish, and 259..383 are MXU-tiling filler —
+        # sampling any of them produces no text (see decodable_vocab_limit)
+        self.decodable_vocab_size = 256
 
     def encode(self, text: str, *, add_bos: bool = False) -> list[int]:
         ids = list(text.encode("utf-8"))

@@ -72,6 +72,9 @@ class Trainer:
         params: dict | None = None,
         seed: int = 0,
     ) -> None:
+        from ..core.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         self.cfg = model_config
         self.mesh = mesh
         self.tc = train_config or TrainConfig()
@@ -99,7 +102,8 @@ class Trainer:
                     f"fsdp axis ({mesh.shape[AXES.fsdp]})"
                 )
         p_shardings = param_shardings(
-            mesh, self.cfg.tie_embeddings, fsdp=self.tc.fsdp
+            mesh, self.cfg.tie_embeddings, fsdp=self.tc.fsdp,
+            qk_norm=self.cfg.qk_norm,
         )
         if params is None:
             # init directly into the sharded layout: each leaf is produced
@@ -153,7 +157,9 @@ class Trainer:
         """PartitionSpecs for the optax state: any state subtree that has the
         params' exact tree structure (AdamW mu/nu) inherits the param specs;
         every other leaf (counters, empty states) replicates."""
-        specs = param_specs(self.cfg.tie_embeddings, fsdp=self.tc.fsdp)
+        specs = param_specs(
+            self.cfg.tie_embeddings, fsdp=self.tc.fsdp, qk_norm=self.cfg.qk_norm
+        )
         abstract = jax.eval_shape(
             lambda: init_params(jax.random.key(0), self.cfg)
         )
