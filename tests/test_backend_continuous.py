@@ -91,23 +91,21 @@ def test_continuous_single_prompt():
     )
 
 
-def test_continuous_auto_enabled_under_mesh():
-    """continuous='auto' stays on under a mesh since round 2: compaction
-    halves batches only down to shapes the data axis still divides, and
-    outputs match the single-device engine (see
-    test_backend_engine.test_mesh_continuous_compaction_fires_and_matches)."""
-    import jax
-
-    from vnsum_tpu.parallel import make_mesh
-
-    if len(jax.devices("cpu")) < 2:
-        pytest.skip("needs 2 cpu devices")
-    mesh = make_mesh({"data": 2, "model": 1}, platform="cpu")
-    be = TpuBackend(
-        model_config=tiny_llama(max_seq_len=128), batch_size=4,
-        max_new_tokens=8, mesh=mesh,
+def test_continuous_auto_policy_is_oneshot():
+    """continuous='auto' resolves to the one-shot program: the measured A/B
+    (PERF.md finding 13, artifacts/compaction_ab.json) shows the segmented
+    path losing token-normalized at every tested shape. Explicit True still
+    enables it."""
+    auto = TpuBackend(
+        model_config=tiny_llama(max_seq_len=128), batch_size=32,
+        max_new_tokens=8,
     )
-    assert be.continuous is True
+    assert auto.continuous is False
+    forced = TpuBackend(
+        model_config=tiny_llama(max_seq_len=128), batch_size=4,
+        max_new_tokens=8, continuous=True,
+    )
+    assert forced.continuous is True
 
 
 def test_sampled_continuous_matches_oneshot():

@@ -142,12 +142,27 @@ class TpuBackend:
         # runs in fixed segments; at segment boundaries finished rows are
         # harvested and the survivors compacted into a half-size program, so
         # ragged generation lengths don't pay full-batch decode for the tail.
-        # Exact for greedy decoding (each row's stream depends only on its
-        # own cache); sampled streams change because the per-step batch
-        # shape changes. Under a mesh, compaction only halves down to batch
-        # shapes that stay divisible by the data axis.
+        # Streams are keyed per row (seed, uid, step) so compaction never
+        # changes which random draws a surviving row makes; across the
+        # batch-shape change, logits can still differ in the last bits
+        # (different matmul tilings accumulate in different orders), so
+        # outputs are bit-identical on same-shape replays and test-exact in
+        # CPU/interpret runs, but near-tie tokens can flip across a
+        # compaction on real hardware. Under a mesh, compaction only halves
+        # down to batch shapes that stay divisible by the data axis.
+        #
+        # "auto" policy, from the measured A/B (artifacts/compaction_ab.json,
+        # PERF.md finding 13): the segmented path LOST token-normalized at
+        # BOTH tested shapes (0.68x at B=8/S=8192, 0.82x at B=64/S=1024,
+        # compactions firing 6-8 times) — segment-boundary host syncs, the
+        # un-donated compaction gather, and the cross-dispatch resident
+        # carry outweigh the shed-row cache savings at summary-length decode
+        # budgets. One-shot (early-exit while_loop) is the default; the
+        # segmented scheduler remains available explicitly for workloads
+        # with long ragged tails (multi-hundred-token budgets where a few
+        # stragglers pin an otherwise-finished batch).
         if continuous == "auto":
-            continuous = True
+            continuous = False
         self.continuous = bool(continuous)
         self.segment_tokens = max(segment_tokens, 1)
         self.min_batch = max(min_batch, 1)

@@ -140,16 +140,27 @@ def _prefill_partial_local(
     S_loc = k_loc.shape[1]
     KV = k_loc.shape[2]
     G = q_per_kv
-    if k_scale is not None:
-        k_loc = k_loc.astype(jnp.float32) * k_scale[..., None]
-        v_loc = v_loc.astype(jnp.float32) * v_scale[..., None]
 
     qg = q.reshape(B, KV, G, hd)
-    scores = (
-        jnp.einsum("bkgh,bskh->bkgs", qg, k_loc,
-                   preferred_element_type=jnp.float32)
-        / jnp.sqrt(jnp.float32(hd))
-    )
+    if k_scale is not None:
+        # int8 cache stays int8 into the MXU (the dtype convert fuses into
+        # the tile load); the per-(token, head) scale is constant over the
+        # contracted hd dim, so it factors out of the dot EXACTLY and
+        # multiplies the scores instead. The f32-dequantized shard copy —
+        # 4x the int8 read, per layer per step — never materializes, which
+        # is most of what a shard-local Pallas kernel would buy here.
+        scores = (
+            jnp.einsum("bkgh,bskh->bkgs", qg, k_loc.astype(qg.dtype),
+                       preferred_element_type=jnp.float32)
+            * k_scale.transpose(0, 2, 1)[:, :, None, :]
+            / jnp.sqrt(jnp.float32(hd))
+        )
+    else:
+        scores = (
+            jnp.einsum("bkgh,bskh->bkgs", qg, k_loc,
+                       preferred_element_type=jnp.float32)
+            / jnp.sqrt(jnp.float32(hd))
+        )
     k_pos = idx * S_loc + jnp.arange(S_loc)
     valid = k_pos[None, :] >= pad_lens[:, None]  # [B, S_loc]
     scores = jnp.where(valid[:, None, None], scores, _NEG)
@@ -157,7 +168,13 @@ def _prefill_partial_local(
     m = jnp.max(scores, axis=-1)                      # [B, KV, G]
     p = jnp.where(valid[:, None, None], jnp.exp(scores - m[..., None]), 0.0)
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bkgs,bskh->bkgh", p, v_loc.astype(jnp.float32))
+    if v_scale is not None:
+        # same trick on the value side: scale the probabilities along s
+        # (constant over hd), keep v int8 in the matmul
+        pv = p * v_scale.transpose(0, 2, 1)[:, :, None, :]
+        o = jnp.einsum("bkgs,bskh->bkgh", pv, v_loc.astype(jnp.float32))
+    else:
+        o = jnp.einsum("bkgs,bskh->bkgh", p, v_loc.astype(jnp.float32))
 
     m_g = jax.lax.pmax(m, axis_name)
     corr = jnp.exp(m - m_g)
