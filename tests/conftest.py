@@ -27,6 +27,7 @@ _SLOW_MODULES = {
     "test_backend_long_context",
     "test_graft_entry",
     "test_model_convert",
+    "test_model_gemma",
     "test_model_llama",
     "test_model_quant",
     "test_ops_decode",

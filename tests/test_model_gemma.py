@@ -1,0 +1,301 @@
+"""Gemma3-family support on the shared decoder stack: GeGLU, sandwich
+(1+w) RMSNorms, embed scaling, query_pre_attn_scalar, per-head QK-norm, and
+alternating sliding/global attention with two RoPE bases.
+
+Parity anchor is HF transformers' Gemma3ForCausalLM on a tiny config — the
+reference sweeps gemma3:4b (run_full_evaluation_pipeline.py:960-962) but
+only ever through Ollama HTTP; here the family runs natively.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from vnsum_tpu.models.convert import (
+    config_from_hf,
+    convert_torch_model,
+    load_hf_checkpoint,
+    save_hf_checkpoint,
+)
+from vnsum_tpu.models.llama import (
+    forward,
+    gemma3_4b,
+    init_kv_cache,
+    init_params,
+    prefill_attention_mask,
+    prefill_positions,
+    tiny_llama,
+)
+
+HF_CFG = dict(
+    vocab_size=384,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=4,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    max_position_embeddings=256,
+    rope_theta=10000.0,
+    rope_local_base_freq=5000.0,
+    rms_norm_eps=1e-6,
+    tie_word_embeddings=True,
+    model_type="gemma3_text",
+    query_pre_attn_scalar=32,
+    # small window + explicit mixed layer types so the sliding path is
+    # actually exercised (layers 0,1,3 sliding / 2 global)
+    sliding_window=8,
+    layer_types=[
+        "sliding_attention", "sliding_attention",
+        "full_attention", "sliding_attention",
+    ],
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch.manual_seed(0)
+    cfg = transformers.Gemma3TextConfig(**{
+        k: v for k, v in HF_CFG.items() if k != "model_type"
+    })
+    return transformers.Gemma3ForCausalLM(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def converted(hf_model):
+    cfg = config_from_hf(HF_CFG, dtype=jnp.float32)
+    assert cfg.sandwich_norms and cfg.norm_plus_one and cfg.embed_scale
+    assert cfg.act == "gelu_tanh"
+    assert cfg.query_scale == 32
+    assert cfg.sliding_window == 8
+    assert cfg.layer_is_global == (False, False, True, False)
+    assert cfg.rope_local_theta == 5000.0
+    params = convert_torch_model(hf_model, cfg)
+    for k in ("q_norm", "k_norm", "post_attn_norm", "post_ffw_norm"):
+        assert k in params["layers"], k
+    return cfg, params
+
+
+def _hf_logits(hf_model, tokens: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        out = hf_model(torch.from_numpy(tokens).long())
+    return out.logits.float().numpy()
+
+
+def _our_logits(cfg, params, tokens: np.ndarray, pad=None) -> np.ndarray:
+    B, S = tokens.shape
+    pad = pad if pad is not None else np.zeros((B,), np.int32)
+    cache = init_kv_cache(cfg, B, S)
+    out, _ = forward(
+        params, cfg, jnp.asarray(tokens),
+        prefill_positions(jnp.asarray(pad), S), cache, 0,
+        prefill_attention_mask(jnp.asarray(pad), S, S),
+    )
+    return np.asarray(out)
+
+
+def test_gemma3_prefill_logit_parity(hf_model, converted):
+    """Sequence long enough (24 > window 8) that sliding layers genuinely
+    mask distant positions — parity fails if window/rope-base selection is
+    wrong on any layer."""
+    cfg, params = converted
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 24), dtype=np.int32)
+    ours = _our_logits(cfg, params, tokens)
+    theirs = _hf_logits(hf_model, tokens)
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-3)
+
+
+def test_gemma3_decode_matches_hf_incremental(hf_model, converted):
+    """KV-cache decode (prefill + single-token steps) must match the HF
+    full-sequence forward at every step — exercises the sliding mask in
+    decode slot space."""
+    from vnsum_tpu.models.llama import decode_attention_mask
+
+    cfg, params = converted
+    rng = np.random.default_rng(1)
+    S, T = 12, 6
+    seq = rng.integers(0, cfg.vocab_size, (1, S + T), dtype=np.int32)
+    theirs = _hf_logits(hf_model, seq)
+
+    C = S + T
+    pad = np.zeros((1,), np.int32)
+    cache = init_kv_cache(cfg, 1, C)
+    logits, cache = forward(
+        params, cfg, jnp.asarray(seq[:, :S]),
+        prefill_positions(jnp.asarray(pad), S), cache, 0,
+        prefill_attention_mask(jnp.asarray(pad), S, C),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), theirs[:, :S], atol=3e-4, rtol=3e-3
+    )
+    for t in range(T):
+        pos = np.asarray([[S + t]], np.int32)
+        step_logits, cache = forward(
+            params, cfg, jnp.asarray(seq[:, S + t : S + t + 1]),
+            jnp.asarray(pos), cache, S + t,
+            decode_attention_mask(jnp.asarray(pad), S + t, C),
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits)[:, 0], theirs[:, S + t],
+            atol=3e-4, rtol=3e-3,
+        )
+
+
+def test_gemma3_hf_checkpoint_roundtrip(tmp_path, converted):
+    cfg, params = converted
+    out = tmp_path / "export"
+    save_hf_checkpoint(params, cfg, str(out))
+    cfg2, params2 = load_hf_checkpoint(str(out), dtype=jnp.float32)
+    assert cfg2.sandwich_norms and cfg2.sliding_window == 8
+    assert cfg2.layer_is_global == cfg.layer_is_global
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, cfg.vocab_size, (1, 16), dtype=np.int32)
+    bf = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16).astype(jnp.float32), params
+    )
+    np.testing.assert_array_equal(
+        _our_logits(cfg, bf, tokens), _our_logits(cfg2, params2, tokens)
+    )
+
+
+def test_gemma3_engine_generate_and_registry():
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.models import MODEL_REGISTRY
+
+    cfg4 = MODEL_REGISTRY["gemma3:4b"]()
+    assert cfg4.sandwich_norms and cfg4.sliding_window == 1024
+    assert sum(cfg4.layer_is_global) == 5  # 34 layers, every 6th global
+
+    tiny_g = tiny_llama(
+        qk_norm=True, act="gelu_tanh", sandwich_norms=True,
+        norm_plus_one=True, embed_scale=True, query_scale=32.0,
+        sliding_window=8,
+        layer_is_global=(False, True),
+    )
+    be = TpuBackend(
+        model_config=tiny_g, tokenizer="byte", batch_size=2,
+        max_new_tokens=8, seed=0,
+    )
+    assert be.flash is False or not tiny_g.sliding_window
+    outs = be.generate(["văn bản một", "hai"])
+    assert len(outs) == 2 and all(isinstance(o, str) for o in outs)
+
+
+def test_gemma3_mesh_sharding():
+    from vnsum_tpu.parallel import make_mesh
+    from vnsum_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh({"data": 2, "model": 2}, platform="cpu")
+    cfg = tiny_llama(
+        qk_norm=True, sandwich_norms=True, norm_plus_one=True,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    sharded = shard_params(params, mesh, cfg.tie_embeddings)
+    assert "post_attn_norm" in sharded["layers"]
+
+
+def test_gemma3_mesh_engine_generates():
+    """Regression (r3 review): _mesh_in_shardings must carry the sandwich
+    norm leaves, or any Gemma3 config under a mesh dies with a pytree
+    structure mismatch at dispatch."""
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 2, "model": 2}, platform="cpu")
+    tiny_g = tiny_llama(
+        qk_norm=True, act="gelu_tanh", sandwich_norms=True,
+        norm_plus_one=True, embed_scale=True, query_scale=32.0,
+        sliding_window=8, layer_is_global=(False, True),
+    )
+    be = TpuBackend(
+        model_config=tiny_g, tokenizer="byte", batch_size=2,
+        max_new_tokens=6, seed=0, mesh=mesh, flash=False,
+    )
+    outs = be.generate(["văn bản một", "hai"])
+    assert len(outs) == 2
+
+
+def test_multimodal_checkpoint_layout_loads(tmp_path, converted):
+    """Real gemma-3-4b+ repos are multimodal: config nested under
+    text_config, tensors under language_model.model.* — the loader must
+    unwrap both."""
+    import json
+    import os
+
+    from safetensors.numpy import load_file, save_file
+
+    cfg, params = converted
+    plain = tmp_path / "plain"
+    save_hf_checkpoint(params, cfg, str(plain))
+
+    mm = tmp_path / "multimodal"
+    os.makedirs(mm)
+    with open(plain / "config.json") as f:
+        inner_cfg = json.load(f)
+    outer = {
+        "architectures": ["Gemma3ForConditionalGeneration"],
+        "model_type": "gemma3",
+        "text_config": inner_cfg,
+    }
+    (mm / "config.json").write_text(json.dumps(outer))
+    index = json.loads((plain / "model.safetensors.index.json").read_text())
+    new_map = {}
+    for shard in set(index["weight_map"].values()):
+        tensors = load_file(str(plain / shard))
+        renamed = {f"language_model.{k}": v for k, v in tensors.items()}
+        save_file(renamed, str(mm / shard))
+        for k in renamed:
+            new_map[k] = shard
+    (mm / "model.safetensors.index.json").write_text(
+        json.dumps({"metadata": index["metadata"], "weight_map": new_map})
+    )
+
+    cfg2, params2 = load_hf_checkpoint(str(mm), dtype=jnp.float32)
+    assert cfg2.sandwich_norms and cfg2.sliding_window == cfg.sliding_window
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, cfg.vocab_size, (1, 16), dtype=np.int32)
+    bf = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16).astype(jnp.float32), params
+    )
+    np.testing.assert_array_equal(
+        _our_logits(cfg, bf, tokens), _our_logits(cfg2, params2, tokens)
+    )
+
+
+def test_registry_configs_shard_structurally():
+    """Every registry family's param tree must match its sharding-spec tree
+    (structure, not shapes) — catches the threading bug class where a new
+    param leaf (q_norm, post_attn_norm, ...) misses a param_specs flag."""
+    import dataclasses
+
+    from vnsum_tpu.models import MODEL_REGISTRY
+    from vnsum_tpu.parallel.sharding import param_specs
+
+    for name, factory in MODEL_REGISTRY.items():
+        cfg = factory()
+        # shrink to a traceable size; structure is all that matters
+        small = dataclasses.replace(
+            cfg, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, head_dim=16,
+            intermediate=128, vocab_size=384, max_seq_len=128,
+            dtype=jnp.float32,
+            layer_is_global=cfg.layer_is_global[:2]
+            if cfg.layer_is_global else (),
+        )
+        params = jax.eval_shape(
+            lambda: init_params(jax.random.key(0), small)
+        )
+        specs = param_specs(
+            small.tie_embeddings,
+            qk_norm=small.qk_norm,
+            sandwich_norms=small.sandwich_norms,
+        )
+        assert (
+            jax.tree.structure(params) == jax.tree.structure(specs)
+        ), f"{name}: params/specs tree mismatch"

@@ -112,6 +112,12 @@ class TpuBackend:
         # are data/model-local, so no cross-chip softmax is needed.
         if flash == "auto":
             flash = jax.default_backend() == "tpu"
+        if self.cfg.sliding_window and flash:
+            # the Pallas kernels attend over the whole valid cache; Gemma's
+            # per-layer window needs kernel-side k-range clamping (future
+            # work) — take the dense path, which applies the window mask
+            logger.info("sliding-window config: Pallas kernels disabled")
+            flash = False
         self.flash = bool(flash)
         # int8 KV cache halves decode-attention HBM traffic; the in-kernel
         # dequant needs the Pallas path, so "auto" follows flash AND actual
@@ -386,6 +392,7 @@ class TpuBackend:
             param_shardings(
                 self.mesh, self.cfg.tie_embeddings, is_quantized(self.params),
                 qk_norm=self.cfg.qk_norm,
+                sandwich_norms=self.cfg.sandwich_norms,
             ),
             ns(P("data", None)),
             ns(P("data")),

@@ -376,6 +376,12 @@ class LongContextBackend:
         from ..core.jax_cache import enable_compilation_cache
 
         enable_compilation_cache()
+        if (model_config is not None) and model_config.sliding_window:
+            raise NotImplementedError(
+                "LongContextBackend runs ring attention (global K/V "
+                "streaming); sliding-window (Gemma local) configs are "
+                "one-chip-engine only"
+            )
         if mesh is None or AXES.seq not in mesh.shape:
             raise ValueError(
                 "LongContextBackend needs a mesh with a 'seq' axis — that "
@@ -533,6 +539,7 @@ class LongContextBackend:
                         self.mesh, self.cfg.tie_embeddings,
                         is_quantized(self.params),
                         qk_norm=self.cfg.qk_norm,
+                        sandwich_norms=self.cfg.sandwich_norms,
                     ),
                     ns(P(AXES.data, AXES.seq)),
                     ns(P(AXES.data)),

@@ -98,8 +98,15 @@ class EmbeddingModel:
             mask[i, : len(ids)] = True
         return toks, mask
 
-    def token_embeddings(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
-        """Returns (embs [N, S, D], mask [N, S]) in fixed-size batches."""
+    def token_embeddings(self, texts: list[str]) -> tuple[jax.Array, np.ndarray]:
+        """Returns (embs [N, S, D] ON DEVICE, mask [N, S] host) in
+        fixed-size batches.
+
+        The embeddings stay device-resident deliberately: downstream
+        consumers (mean pooling, BERTScore greedy matching) run on device,
+        and only their small [N] / [N, D] results cross to the host. The
+        earlier host round trip of the full [N, S, D] tensor dominated the
+        evaluation pass on a slow device link (~25 MB per batch each way)."""
         embs, masks = [], []
         for start in range(0, len(texts), self.batch_size):
             chunk = texts[start : start + self.batch_size]
@@ -108,15 +115,15 @@ class EmbeddingModel:
             toks, mask = self._batch_tokens(
                 chunk + [""] * (self.batch_size - len(chunk))
             )
-            out = np.asarray(self._encode(self.params, tokens=toks, mask=mask))
+            out = self._encode(self.params, tokens=toks, mask=mask)
             embs.append(out[: len(chunk)])
             masks.append(mask[: len(chunk)])
-        return np.concatenate(embs), np.concatenate(masks)
+        return jnp.concatenate(embs), np.concatenate(masks)
 
     def sentence_embeddings(self, texts: list[str]) -> np.ndarray:
         """L2-normalized mean-pooled embeddings [N, D]."""
         embs, mask = self.token_embeddings(texts)
-        return np.asarray(mean_pool(jnp.asarray(embs), jnp.asarray(mask)))
+        return np.asarray(mean_pool(embs, jnp.asarray(mask)))
 
 
 def cosine_similarities(a: np.ndarray, b: np.ndarray) -> np.ndarray:

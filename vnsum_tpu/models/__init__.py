@@ -5,6 +5,7 @@ from .llama import (
     init_kv_cache,
     init_params,
     is_quantized_cache,
+    gemma3_4b,
     llama32_1b,
     llama32_3b,
     qwen3_0p6b,
@@ -24,6 +25,8 @@ MODEL_REGISTRY = {
     "qwen3-8b": qwen3_8b,
     "qwen3:0.6b": qwen3_0p6b,
     "qwen3-0.6b": qwen3_0p6b,
+    "gemma3:4b": gemma3_4b,
+    "gemma3-4b": gemma3_4b,
     "tiny": tiny_llama,
 }
 
@@ -32,6 +35,7 @@ __all__ = [
     "forward",
     "init_kv_cache",
     "init_params",
+    "gemma3_4b",
     "llama32_1b",
     "llama32_3b",
     "qwen3_0p6b",

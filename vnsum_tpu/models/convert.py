@@ -45,9 +45,21 @@ _QK_NORM_KEYS: dict[str, str] = {
     "self_attn.k_norm.weight": "k_norm",
 }
 
+# Gemma3 sandwich norms: post_attention_layernorm is the POST-attention
+# norm there (Llama reuses that HF name for the pre-MLP norm), and the MLP
+# pre-norm is pre_feedforward_layernorm
+_GEMMA_NORM_KEYS: dict[str, str] = {
+    "input_layernorm.weight": "attn_norm",
+    "post_attention_layernorm.weight": "post_attn_norm",
+    "pre_feedforward_layernorm.weight": "mlp_norm",
+    "post_feedforward_layernorm.weight": "post_ffw_norm",
+}
+
 
 def _layer_keys(cfg: LlamaConfig) -> dict[str, str]:
     keys = dict(_LAYER_KEYS)
+    if cfg.sandwich_norms:
+        keys.update(_GEMMA_NORM_KEYS)  # remaps the two shared HF norm names
     if cfg.qk_norm:
         keys.update(_QK_NORM_KEYS)
     return keys
@@ -55,14 +67,21 @@ def _layer_keys(cfg: LlamaConfig) -> dict[str, str]:
 
 def config_from_hf(hf: Mapping[str, Any], **overrides) -> LlamaConfig:
     """Build a :class:`LlamaConfig` from a parsed HF ``config.json`` dict."""
+    if "text_config" in hf:
+        # multimodal wrapper (gemma-3-4b+ repos ship
+        # Gemma3ForConditionalGeneration): the decoder lives in text_config
+        inner = dict(hf["text_config"])
+        inner.setdefault("model_type", hf.get("model_type", "llama"))
+        hf = inner
     rope_scaling = hf.get("rope_scaling") or {}
     rope_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
     head_dim = hf.get("head_dim") or (
         hf["hidden_size"] // hf["num_attention_heads"]
     )
     model_type = hf.get("model_type", "llama")
+    gemma = model_type.startswith("gemma3")
     kw: dict[str, Any] = dict(
-        qk_norm=model_type.startswith("qwen3"),
+        qk_norm=model_type.startswith("qwen3") or gemma,
         vocab_size=hf["vocab_size"],
         dim=hf["hidden_size"],
         n_layers=hf["num_hidden_layers"],
@@ -86,6 +105,32 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> LlamaConfig:
             rope_original_max_len=rope_scaling.get(
                 "original_max_position_embeddings", 8192
             ),
+        )
+    elif rope_type == "linear":
+        kw["rope_linear_factor"] = rope_scaling.get("factor", 1.0)
+    if gemma:
+        n_layers = hf["num_hidden_layers"]
+        layer_types = hf.get("layer_types")
+        if layer_types:
+            is_global = tuple(t == "full_attention" for t in layer_types)
+        else:
+            pattern = hf.get("sliding_window_pattern", 6)
+            is_global = tuple(
+                (i + 1) % pattern == 0 for i in range(n_layers)
+            )
+        kw.update(
+            act="gelu_tanh",
+            sandwich_norms=True,
+            norm_plus_one=True,
+            embed_scale=True,
+            query_scale=float(hf.get("query_pre_attn_scalar") or 0.0),
+            sliding_window=int(hf.get("sliding_window") or 0),
+            layer_is_global=is_global,
+            rope_local_theta=float(
+                hf.get("rope_local_base_freq", 10_000.0)
+            ),
+            # Gemma ties embeddings unless the config says otherwise
+            tie_embeddings=hf.get("tie_word_embeddings", True),
         )
     kw.update(overrides)
     return LlamaConfig(**kw)
@@ -178,12 +223,35 @@ def load_hf_checkpoint(
 
     ``dtype`` applies to BOTH the converted params and the returned config —
     the config's dtype drives KV-cache/activation dtypes downstream, and a
-    float32 param tree against a bfloat16 cache is a dispatch-time error."""
+    float32 param tree against a bfloat16 cache is a dispatch-time error.
+
+    Multimodal Gemma3 repos (Gemma3ForConditionalGeneration) are handled:
+    the decoder config is unwrapped from ``text_config`` and tensor keys
+    resolve under the ``language_model.`` prefix (vision-tower tensors are
+    simply never requested)."""
     if dtype is not None:
         config_overrides.setdefault("dtype", dtype)
     with open(os.path.join(model_dir, "config.json")) as f:
         cfg = config_from_hf(json.load(f), **config_overrides)
-    params = convert_hf_state_dict(_safetensors_getter(model_dir), cfg, dtype)
+    get = _safetensors_getter(model_dir)
+    probe = "model.embed_tokens.weight"
+    try:
+        get(probe)
+    except KeyError:
+        mm = f"language_model.{probe}"
+        try:
+            get(mm)
+        except KeyError:
+            raise KeyError(
+                f"neither {probe!r} nor {mm!r} found in {model_dir} — not a "
+                "Llama/Qwen3/Gemma3 text or multimodal checkpoint layout"
+            ) from None
+        inner = get
+
+        def get(name: str, _inner=inner):  # noqa: F811
+            return _inner(f"language_model.{name}")
+
+    params = convert_hf_state_dict(get, cfg, dtype)
     return cfg, params
 
 
@@ -223,11 +291,15 @@ def save_hf_checkpoint(
             return arr.T
         return arr  # norms
 
+    if cfg.sandwich_norms:
+        arch, mtype = ["Gemma3ForCausalLM"], "gemma3_text"
+    elif cfg.qk_norm:
+        arch, mtype = ["Qwen3ForCausalLM"], "qwen3"
+    else:
+        arch, mtype = ["LlamaForCausalLM"], "llama"
     hf_cfg = {
-        "architectures": (
-            ["Qwen3ForCausalLM"] if cfg.qk_norm else ["LlamaForCausalLM"]
-        ),
-        "model_type": "qwen3" if cfg.qk_norm else "llama",
+        "architectures": arch,
+        "model_type": mtype,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.dim,
         "num_hidden_layers": cfg.n_layers,
@@ -249,6 +321,23 @@ def save_hf_checkpoint(
             "high_freq_factor": cfg.rope_high_freq_factor,
             "original_max_position_embeddings": cfg.rope_original_max_len,
         }
+    elif cfg.rope_linear_factor:
+        hf_cfg["rope_scaling"] = {
+            "rope_type": "linear", "factor": cfg.rope_linear_factor,
+        }
+    if cfg.sandwich_norms:
+        hf_cfg.update(
+            hidden_activation="gelu_pytorch_tanh",
+            query_pre_attn_scalar=cfg.query_scale or cfg.head_dim,
+            sliding_window=cfg.sliding_window,
+            layer_types=[
+                "full_attention" if g else "sliding_attention"
+                for g in (
+                    cfg.layer_is_global or [True] * cfg.n_layers
+                )
+            ],
+            rope_local_base_freq=cfg.rope_local_theta,
+        )
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf_cfg, f, indent=2)
 

@@ -49,6 +49,19 @@ class LlamaConfig:
     # through this module (reference sweeps qwen3:8b alongside llama3.2:3b,
     # run_full_evaluation_pipeline.py:960-962)
     qk_norm: bool = False
+    # --- Gemma3 deltas (reference sweeps gemma3:4b) — all default-off so
+    # the Llama/Qwen traces are unchanged ---
+    act: str = "silu"              # "silu" | "gelu_tanh" (GeGLU)
+    sandwich_norms: bool = False   # post-attention + pre/post-FFW norms
+    norm_plus_one: bool = False    # RMSNorm scale is (1 + w), zero-init w
+    embed_scale: bool = False      # hidden states scaled by sqrt(dim)
+    query_scale: float = 0.0       # 0 => 1/sqrt(head_dim); else 1/sqrt(this)
+    sliding_window: int = 0        # 0 => every layer attends globally
+    # per-layer attention kind when sliding_window > 0: True = global.
+    # Gemma3 interleaves 5 sliding : 1 global
+    layer_is_global: tuple = ()
+    rope_local_theta: float = 10_000.0  # RoPE base for sliding layers
+    rope_linear_factor: float = 0.0     # linear position scaling (Gemma3 global)
     dtype: Any = field(default=jnp.bfloat16)
 
     @property
@@ -91,6 +104,25 @@ def qwen3_0p6b(**kw) -> LlamaConfig:
     return LlamaConfig(**base)
 
 
+def gemma3_4b(**kw) -> LlamaConfig:
+    """Gemma3-4B text decoder (reference model family #3,
+    run_full_evaluation_pipeline.py:960-962 `gemma3:4b`)."""
+    n_layers = 34
+    base = dict(
+        vocab_size=262_208, dim=2560, n_layers=n_layers, n_heads=8,
+        n_kv_heads=4, head_dim=256, intermediate=10_240,
+        rope_theta=1_000_000.0, use_llama3_rope_scaling=False,
+        rope_linear_factor=8.0, norm_eps=1e-6, max_seq_len=32_768,
+        tie_embeddings=True, qk_norm=True, act="gelu_tanh",
+        sandwich_norms=True, norm_plus_one=True, embed_scale=True,
+        query_scale=256.0, sliding_window=1024,
+        layer_is_global=tuple((i + 1) % 6 == 0 for i in range(n_layers)),
+        rope_local_theta=10_000.0,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
 def tiny_llama(**kw) -> LlamaConfig:
     """Small config for hermetic CPU tests."""
     base = dict(
@@ -117,24 +149,29 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     def norm(shape, k, scale=0.02):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
 
+    # plus-one norms (Gemma) are zero-centered: w=0 means identity scale
+    norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones
     params = {
         "embed": norm((cfg.vocab_size, D), next(keys)),
         "layers": {
-            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "attn_norm": norm_init((L, D), cfg.dtype),
             "wq": norm((L, D, H, hd), next(keys)),
             "wk": norm((L, D, KV, hd), next(keys)),
             "wv": norm((L, D, KV, hd), next(keys)),
             "wo": norm((L, H, hd, D), next(keys)),
-            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+            "mlp_norm": norm_init((L, D), cfg.dtype),
             "w_gate": norm((L, D, I), next(keys)),
             "w_up": norm((L, D, I), next(keys)),
             "w_down": norm((L, I, D), next(keys)),
         },
-        "final_norm": jnp.ones((D,), cfg.dtype),
+        "final_norm": norm_init((D,), cfg.dtype),
     }
     if cfg.qk_norm:
-        params["layers"]["q_norm"] = jnp.ones((L, hd), cfg.dtype)
-        params["layers"]["k_norm"] = jnp.ones((L, hd), cfg.dtype)
+        params["layers"]["q_norm"] = norm_init((L, hd), cfg.dtype)
+        params["layers"]["k_norm"] = norm_init((L, hd), cfg.dtype)
+    if cfg.sandwich_norms:
+        params["layers"]["post_attn_norm"] = norm_init((L, D), cfg.dtype)
+        params["layers"]["post_ffw_norm"] = norm_init((L, D), cfg.dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = norm((D, cfg.vocab_size), next(keys))
     return params
@@ -234,10 +271,21 @@ def _lm_head_logits(x: jax.Array, params: dict, cfg: "LlamaConfig") -> jax.Array
     return jnp.einsum(sub, x, w, preferred_element_type=jnp.float32)
 
 
-def _rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def _rmsnorm(
+    x: jax.Array, w: jax.Array, eps: float, plus_one: bool = False
+) -> jax.Array:
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    if plus_one:
+        # Gemma-family RMSNorm: zero-centered weight, applied in float32
+        return ((x32 * scale) * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
     return (x32 * scale).astype(x.dtype) * w
+
+
+def _mlp_act(x: jax.Array, act: str) -> jax.Array:
+    if act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 def _rope_inv_freq(cfg: LlamaConfig) -> jax.Array:
@@ -263,7 +311,10 @@ def _rope_inv_freq(cfg: LlamaConfig) -> jax.Array:
 
 def _rope_cos_sin(cfg: LlamaConfig, positions: jax.Array):
     """positions [B, S] -> cos/sin [B, S, hd/2] (float32)."""
-    angles = positions[..., None].astype(jnp.float32) * _rope_inv_freq(cfg)
+    pos = positions[..., None].astype(jnp.float32)
+    if cfg.rope_linear_factor:
+        pos = pos / cfg.rope_linear_factor
+    angles = pos * _rope_inv_freq(cfg)
     return jnp.cos(angles), jnp.sin(angles)
 
 
@@ -303,7 +354,7 @@ def _attention(
 
 
 def _block(
-    x, lp, layer_idx, cos, sin, mask, cache, write_index,
+    x, lp, layer_idx, rope, mask, is_global, cache, write_index,
     cfg: LlamaConfig, attention_fn=None, stacked_attention_fn=None,
 ):
     """One decoder layer.
@@ -315,14 +366,40 @@ def _block(
     decode HBM traffic at weights+cache-read — emitting per-layer caches as
     scan outputs would re-materialize the whole ~GB cache every decode
     step."""
-    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    P1 = cfg.norm_plus_one
+    cos, sin = rope[0]
+    if cfg.sliding_window:
+        # per-layer global/sliding select: rope pair 1 and the windowed
+        # mask apply on sliding layers (is_global is a traced per-layer
+        # scalar from the scan xs). Static-gated: the Llama/Qwen traces
+        # never build these selects.
+        (cos_l, sin_l) = rope[1]
+        cos = jnp.where(is_global, cos, cos_l)
+        sin = jnp.where(is_global, sin, sin_l)
+        C = mask.shape[-1]
+        S = x.shape[1]
+        q_slot = write_index + jnp.arange(S)
+        k_slot = jnp.arange(C)
+        in_window = (
+            k_slot[None, :] > q_slot[:, None] - cfg.sliding_window
+        )[None]
+        mask = mask & (is_global | in_window)
+
+    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps, P1)
     q = _proj("bsd,dhk->bshk", h, lp["wq"])
     k = _proj("bsd,dhk->bshk", h, lp["wk"])
     v = _proj("bsd,dhk->bshk", h, lp["wv"])
     if cfg.qk_norm:
-        # Qwen3: RMSNorm over each head's hd dim before RoPE
-        q = _rmsnorm(q, lp["q_norm"], cfg.norm_eps)
-        k = _rmsnorm(k, lp["k_norm"], cfg.norm_eps)
+        # Qwen3/Gemma3: RMSNorm over each head's hd dim before RoPE
+        q = _rmsnorm(q, lp["q_norm"], cfg.norm_eps, P1)
+        k = _rmsnorm(k, lp["k_norm"], cfg.norm_eps, P1)
+    if cfg.query_scale:
+        # fold a non-default score scale (Gemma's query_pre_attn_scalar)
+        # into q so every attention implementation (dense, ring, Pallas)
+        # keeps its built-in 1/sqrt(head_dim)
+        q = q * jnp.asarray(
+            (cfg.head_dim ** 0.5) / (cfg.query_scale ** 0.5), q.dtype
+        )
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
 
@@ -370,12 +447,16 @@ def _block(
         else:
             attn = attention_fn(q, k_cache, v_cache, mask, cfg.q_per_kv)
     attn_out = _proj("bshk,hkd->bsd", attn, lp["wo"])
+    if cfg.sandwich_norms:
+        attn_out = _rmsnorm(attn_out, lp["post_attn_norm"], cfg.norm_eps, P1)
     x = x + attn_out
 
-    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, P1)
     gate = _proj("bsd,di->bsi", h, lp["w_gate"])
     up = _proj("bsd,di->bsi", h, lp["w_up"])
-    mlp_out = _proj("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+    mlp_out = _proj("bsi,id->bsd", _mlp_act(gate, cfg.act) * up, lp["w_down"])
+    if cfg.sandwich_norms:
+        mlp_out = _rmsnorm(mlp_out, lp["post_ffw_norm"], cfg.norm_eps, P1)
     return x + mlp_out, cache
 
 
@@ -405,7 +486,20 @@ def forward(
     consumer of the FULL stacked cache dict (the Pallas kernels) and takes
     precedence."""
     x = _embed_lookup(params["embed"], tokens, cfg.dtype)
-    cos, sin = _rope_cos_sin(cfg, positions)
+    if cfg.embed_scale:
+        # Gemma scales hidden states by sqrt(dim), rounded through the
+        # model dtype like the HF implementation's normalizer
+        x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
+    rope = (_rope_cos_sin(cfg, positions),)
+    if cfg.sliding_window:
+        import dataclasses as _dc
+
+        local_cfg = _dc.replace(
+            cfg, rope_theta=cfg.rope_local_theta,
+            use_llama3_rope_scaling=False, rope_linear_factor=0.0,
+        )
+        rope = rope + (_rope_cos_sin(local_cfg, positions),)
+    flags = _layer_global_flags(cfg)
 
     block = _block
     if remat:
@@ -413,9 +507,9 @@ def forward(
 
     def layer_step(carry, xs):
         h, cache = carry
-        lp, li = xs
+        lp, li, is_global = xs
         h, cache = block(
-            h, lp, li, cos, sin, mask, cache, write_index, cfg,
+            h, lp, li, rope, mask, is_global, cache, write_index, cfg,
             attention_fn, stacked_attention_fn,
         )
         return (h, cache), None
@@ -423,14 +517,26 @@ def forward(
     (x, new_cache), _ = jax.lax.scan(
         layer_step,
         (x, kv_cache),
-        (params["layers"], jnp.arange(cfg.n_layers)),
+        (params["layers"], jnp.arange(cfg.n_layers), flags),
     )
 
     if last_only:
         x = x[:, -1:, :]
-    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
     logits = _lm_head_logits(x, params, cfg)
     return logits, new_cache
+
+
+def _layer_global_flags(cfg: LlamaConfig) -> jax.Array:
+    """[L] bool — which layers attend globally (all, unless sliding)."""
+    if cfg.sliding_window and cfg.layer_is_global:
+        if len(cfg.layer_is_global) != cfg.n_layers:
+            raise ValueError(
+                f"layer_is_global has {len(cfg.layer_is_global)} entries "
+                f"for {cfg.n_layers} layers"
+            )
+        return jnp.asarray(cfg.layer_is_global, dtype=bool)
+    return jnp.ones((cfg.n_layers,), dtype=bool)
 
 
 def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, q_per_kv: int):
@@ -452,23 +558,38 @@ def cache_free_block(x, lp, cos, sin, cfg: LlamaConfig, attention_fn):
     """One cache-free decoder layer; returns (x, (k, v)) with k/v
     projection-shaped [B, S, KV, hd]. Shared by forward_train (which
     discards the k/v) and the long-context ring prefill (which stacks them
-    into the frozen prefill cache) — ONE copy of the block math."""
-    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    into the frozen prefill cache) — ONE copy of the block math.
+
+    Sliding-window (Gemma local) layers are NOT supported on this path —
+    ring attention streams global K/V blocks; callers gate on
+    cfg.sliding_window."""
+    P1 = cfg.norm_plus_one
+    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps, P1)
     q = _proj("bsd,dhk->bshk", h, lp["wq"])
     k = _proj("bsd,dhk->bshk", h, lp["wk"])
     v = _proj("bsd,dhk->bshk", h, lp["wv"])
     if cfg.qk_norm:
-        # Qwen3: RMSNorm over each head's hd dim before RoPE
-        q = _rmsnorm(q, lp["q_norm"], cfg.norm_eps)
-        k = _rmsnorm(k, lp["k_norm"], cfg.norm_eps)
+        # Qwen3/Gemma3: RMSNorm over each head's hd dim before RoPE
+        q = _rmsnorm(q, lp["q_norm"], cfg.norm_eps, P1)
+        k = _rmsnorm(k, lp["k_norm"], cfg.norm_eps, P1)
+    if cfg.query_scale:
+        q = q * jnp.asarray(
+            (cfg.head_dim ** 0.5) / (cfg.query_scale ** 0.5), q.dtype
+        )
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
     attn = attention_fn(q, k, v, cfg.q_per_kv)
-    x = x + _proj("bshk,hkd->bsd", attn, lp["wo"])
-    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    attn_out = _proj("bshk,hkd->bsd", attn, lp["wo"])
+    if cfg.sandwich_norms:
+        attn_out = _rmsnorm(attn_out, lp["post_attn_norm"], cfg.norm_eps, P1)
+    x = x + attn_out
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, P1)
     gate = _proj("bsd,di->bsi", h, lp["w_gate"])
     up = _proj("bsd,di->bsi", h, lp["w_up"])
-    return x + _proj("bsi,id->bsd", jax.nn.silu(gate) * up, lp["w_down"]), (k, v)
+    mlp_out = _proj("bsi,id->bsd", _mlp_act(gate, cfg.act) * up, lp["w_down"])
+    if cfg.sandwich_norms:
+        mlp_out = _rmsnorm(mlp_out, lp["post_ffw_norm"], cfg.norm_eps, P1)
+    return x + mlp_out, (k, v)
 
 
 def forward_train(
@@ -486,8 +607,15 @@ def forward_train(
     attention over a sharded sequence axis instead of dense attention.
     """
     B, S = tokens.shape
+    if cfg.sliding_window:
+        raise NotImplementedError(
+            "sliding-window (Gemma local) layers are not supported on the "
+            "cache-free train/ring path; use the KV-cache forward"
+        )
     attention_fn = attention_fn or dense_causal_attention
     x = _embed_lookup(params["embed"], tokens, cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
     cos, sin = _rope_cos_sin(cfg, positions)
 
@@ -502,7 +630,7 @@ def forward_train(
         return block(carry, lp), None
 
     x, _ = jax.lax.scan(layer_step, x, params["layers"])
-    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
     return _lm_head_logits(x, params, cfg)
 
 

@@ -23,6 +23,7 @@ def param_specs(
     quantized: bool = False,
     fsdp: bool = False,
     qk_norm: bool = False,
+    sandwich_norms: bool = False,
 ) -> dict[str, Any]:
     """PartitionSpec pytree matching models.llama param structure.
 
@@ -57,6 +58,9 @@ def param_specs(
         # per-head Q/K norms [L, hd]: tiny, replicated over model
         specs["layers"]["q_norm"] = P(L, None)
         specs["layers"]["k_norm"] = P(L, None)
+    if sandwich_norms:
+        specs["layers"]["post_attn_norm"] = P(L, None)
+        specs["layers"]["post_ffw_norm"] = P(L, None)
     if not tie_embeddings:
         specs["lm_head"] = P(None, _M)       # [D, V]
     if quantized:
@@ -101,10 +105,11 @@ def param_shardings(
     quantized: bool = False,
     fsdp: bool = False,
     qk_norm: bool = False,
+    sandwich_norms: bool = False,
 ) -> dict[str, Any]:
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(tie_embeddings, quantized, fsdp, qk_norm),
+        param_specs(tie_embeddings, quantized, fsdp, qk_norm, sandwich_norms),
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -119,7 +124,10 @@ def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True) -> Any:
 
     quantized = is_quantized(params)
     qk_norm = "q_norm" in params["layers"]
-    specs = param_specs(tie_embeddings, quantized, qk_norm=qk_norm)
+    sandwich = "post_attn_norm" in params["layers"]
+    specs = param_specs(
+        tie_embeddings, quantized, qk_norm=qk_norm, sandwich_norms=sandwich
+    )
 
     def check(leaf, spec):
         for dim, axis in enumerate(spec):
@@ -134,5 +142,8 @@ def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True) -> Any:
                 )
 
     jax.tree.map(check, params, specs, is_leaf=lambda x: isinstance(x, P))
-    shardings = param_shardings(mesh, tie_embeddings, quantized, qk_norm=qk_norm)
+    shardings = param_shardings(
+        mesh, tie_embeddings, quantized, qk_norm=qk_norm,
+        sandwich_norms=sandwich,
+    )
     return jax.tree.map(jax.device_put, params, shardings)

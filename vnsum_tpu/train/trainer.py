@@ -104,6 +104,7 @@ class Trainer:
         p_shardings = param_shardings(
             mesh, self.cfg.tie_embeddings, fsdp=self.tc.fsdp,
             qk_norm=self.cfg.qk_norm,
+            sandwich_norms=self.cfg.sandwich_norms,
         )
         if params is None:
             # init directly into the sharded layout: each leaf is produced
@@ -158,7 +159,9 @@ class Trainer:
         params' exact tree structure (AdamW mu/nu) inherits the param specs;
         every other leaf (counters, empty states) replicates."""
         specs = param_specs(
-            self.cfg.tie_embeddings, fsdp=self.tc.fsdp, qk_norm=self.cfg.qk_norm
+            self.cfg.tie_embeddings, fsdp=self.tc.fsdp,
+            qk_norm=self.cfg.qk_norm,
+            sandwich_norms=self.cfg.sandwich_norms,
         )
         abstract = jax.eval_shape(
             lambda: init_params(jax.random.key(0), self.cfg)
