@@ -29,6 +29,7 @@ _SLOW_MODULES = {
     "test_model_convert",
     "test_model_gemma",
     "test_model_llama",
+    "test_model_phi",
     "test_model_quant",
     "test_ops_decode",
     "test_ops_flash",

@@ -128,10 +128,15 @@ class TpuBackend:
         if quantize_kv == "auto":
             quantize_kv = self.flash and kernels_supported
         elif quantize_kv and not (self.flash and kernels_supported):
+            reason = (
+                "sliding-window configs disable the Pallas kernels (no "
+                "window support yet)"
+                if self.cfg.sliding_window
+                else "requires flash=True and head_dim a multiple of 128"
+            )
             raise ValueError(
-                "quantize_kv=True requires the Pallas kernels (flash=True "
-                "and head_dim a multiple of 128); the dense fallback would "
-                "dequantize the whole cache per step"
+                f"quantize_kv=True needs the Pallas kernels: {reason}; the "
+                "dense fallback would dequantize the whole cache per step"
             )
         self.quantize_kv = bool(quantize_kv)
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer

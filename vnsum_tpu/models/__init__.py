@@ -8,6 +8,7 @@ from .llama import (
     gemma3_4b,
     llama32_1b,
     llama32_3b,
+    phi4_14b,
     qwen3_0p6b,
     qwen3_8b,
     tiny_llama,
@@ -27,6 +28,8 @@ MODEL_REGISTRY = {
     "qwen3-0.6b": qwen3_0p6b,
     "gemma3:4b": gemma3_4b,
     "gemma3-4b": gemma3_4b,
+    "phi4:14b": phi4_14b,
+    "phi4-14b": phi4_14b,
     "tiny": tiny_llama,
 }
 
@@ -37,6 +40,7 @@ __all__ = [
     "init_params",
     "gemma3_4b",
     "llama32_1b",
+    "phi4_14b",
     "llama32_3b",
     "qwen3_0p6b",
     "qwen3_8b",

@@ -80,6 +80,13 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> LlamaConfig:
     )
     model_type = hf.get("model_type", "llama")
     gemma = model_type.startswith("gemma3")
+    if model_type.startswith("phi3") and (
+        hf.get("partial_rotary_factor") or 1.0
+    ) != 1.0:
+        raise NotImplementedError(
+            "partial rotary (phi3-small style) is not supported; phi-4 "
+            "uses the full rotary dim"
+        )
     kw: dict[str, Any] = dict(
         qk_norm=model_type.startswith("qwen3") or gemma,
         vocab_size=hf["vocab_size"],
@@ -108,6 +115,14 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> LlamaConfig:
         )
     elif rope_type == "linear":
         kw["rope_linear_factor"] = rope_scaling.get("factor", 1.0)
+    elif rope_type is not None:
+        # e.g. Phi-3's "longrope" (per-band short/long factor arrays):
+        # silently dropping a scaling scheme would load fine and generate
+        # subtly wrong logits — fail loudly instead
+        raise NotImplementedError(
+            f"rope_scaling type {rope_type!r} is not supported "
+            "(have: llama3, linear)"
+        )
     if gemma:
         n_layers = hf["num_hidden_layers"]
         layer_types = hf.get("layer_types")
@@ -183,6 +198,37 @@ def convert_hf_state_dict(
     return params
 
 
+def _phi_fused_getter(
+    get: Callable[[str], np.ndarray], cfg: LlamaConfig
+) -> Callable[[str], np.ndarray]:
+    """Adapter for Phi-3/Phi-4 checkpoints (the reference sweeps phi4:14b):
+    attention arrives as ONE fused ``qkv_proj`` [(H+2KV)*hd, D] and the MLP
+    as ``gate_up_proj`` [2I, D]; serve the split q/k/v/gate/up names the
+    shared converter expects as row slices of the fused tensors."""
+    H, KV, hd, I = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.intermediate
+    q_rows, kv_rows = H * hd, KV * hd
+
+    def fused(name: str) -> np.ndarray:
+        if ".self_attn." in name and name.endswith("_proj.weight"):
+            part = name.rsplit(".", 2)[-2]  # q_proj / k_proj / v_proj
+            if part in ("q_proj", "k_proj", "v_proj"):
+                w = np.asarray(get(name.replace(part, "qkv_proj")))
+                if part == "q_proj":
+                    return w[:q_rows]
+                if part == "k_proj":
+                    return w[q_rows : q_rows + kv_rows]
+                return w[q_rows + kv_rows : q_rows + 2 * kv_rows]
+        if ".mlp." in name and name.endswith("gate_proj.weight"):
+            w = np.asarray(get(name.replace("gate_proj", "gate_up_proj")))
+            return w[:I]
+        if ".mlp." in name and name.endswith("up_proj.weight"):
+            w = np.asarray(get(name.replace("up_proj", "gate_up_proj")))
+            return w[I:]
+        return get(name)
+
+    return fused
+
+
 def _safetensors_getter(model_dir: str) -> Callable[[str], np.ndarray]:
     """Key -> tensor across one or many ``*.safetensors`` shards."""
     from safetensors import safe_open
@@ -213,6 +259,7 @@ def _safetensors_getter(model_dir: str) -> Callable[[str], np.ndarray]:
             )
         return handles[shard].get_tensor(name)
 
+    get.has = weight_map.__contains__  # cheap layout probes, no tensor I/O
     return get
 
 
@@ -235,21 +282,23 @@ def load_hf_checkpoint(
         cfg = config_from_hf(json.load(f), **config_overrides)
     get = _safetensors_getter(model_dir)
     probe = "model.embed_tokens.weight"
-    try:
-        get(probe)
-    except KeyError:
+    if not get.has(probe):
         mm = f"language_model.{probe}"
-        try:
-            get(mm)
-        except KeyError:
+        if not get.has(mm):
             raise KeyError(
                 f"neither {probe!r} nor {mm!r} found in {model_dir} — not a "
                 "Llama/Qwen3/Gemma3 text or multimodal checkpoint layout"
-            ) from None
+            )
         inner = get
 
         def get(name: str, _inner=inner):  # noqa: F811
             return _inner(f"language_model.{name}")
+
+        get.has = lambda name, _h=inner.has: _h(f"language_model.{name}")
+
+    # Phi-3/Phi-4 fused-projection layout: probe and adapt
+    if get.has("model.layers.0.self_attn.qkv_proj.weight"):
+        get = _phi_fused_getter(get, cfg)
 
     params = convert_hf_state_dict(get, cfg, dtype)
     return cfg, params

@@ -123,6 +123,19 @@ def gemma3_4b(**kw) -> LlamaConfig:
     return LlamaConfig(**base)
 
 
+def phi4_14b(**kw) -> LlamaConfig:
+    """Phi-4 decoder (reference model family #4, `phi4:14b`): Llama math
+    with fused-projection checkpoints (models.convert._phi_fused_getter)."""
+    base = dict(
+        vocab_size=100_352, dim=5120, n_layers=40, n_heads=40,
+        n_kv_heads=10, head_dim=128, intermediate=17_920,
+        rope_theta=250_000.0, use_llama3_rope_scaling=False,
+        norm_eps=1e-5, max_seq_len=16_384, tie_embeddings=False,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
 def tiny_llama(**kw) -> LlamaConfig:
     """Small config for hermetic CPU tests."""
     base = dict(
@@ -528,15 +541,21 @@ def forward(
 
 
 def _layer_global_flags(cfg: LlamaConfig) -> jax.Array:
-    """[L] bool — which layers attend globally (all, unless sliding)."""
-    if cfg.sliding_window and cfg.layer_is_global:
-        if len(cfg.layer_is_global) != cfg.n_layers:
-            raise ValueError(
-                f"layer_is_global has {len(cfg.layer_is_global)} entries "
-                f"for {cfg.n_layers} layers"
-            )
-        return jnp.asarray(cfg.layer_is_global, dtype=bool)
-    return jnp.ones((cfg.n_layers,), dtype=bool)
+    """[L] bool — which layers attend globally.
+
+    With sliding_window set and no explicit layer_is_global, EVERY layer is
+    sliding (Mistral-style) — a silent all-global fallback would make the
+    window a no-op while still paying its dense-path costs."""
+    if not cfg.sliding_window:
+        return jnp.ones((cfg.n_layers,), dtype=bool)
+    if not cfg.layer_is_global:
+        return jnp.zeros((cfg.n_layers,), dtype=bool)
+    if len(cfg.layer_is_global) != cfg.n_layers:
+        raise ValueError(
+            f"layer_is_global has {len(cfg.layer_is_global)} entries "
+            f"for {cfg.n_layers} layers"
+        )
+    return jnp.asarray(cfg.layer_is_global, dtype=bool)
 
 
 def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, q_per_kv: int):
