@@ -14,8 +14,11 @@ Phases, one shared set of int8 Llama-3.2-3B weights:
    termination/compaction behavior matches a real checkpoint's. Wall-clock
    covers ALL of it; vs_baseline is docs/min against the reference's
    fastest 3B run on the same-sized docs (20.0 s/doc).
-3. **Second/third strategy** — iterative and hierarchical summarize-only
-   runs on the same corpus (4 docs), against their BASELINE.md rows.
+3. **Other strategies** — iterative, hierarchical, and mapreduce_critique
+   summarize-only runs on the same corpus (4 docs), against their
+   BASELINE.md rows. With the 16k truncated row
+   (artifacts/bench_16k.json) every one of the five approaches has an
+   on-chip measurement.
 
 Prints ONE JSON line: the map-step metric stays the headline (comparable
 across rounds), with the pipeline numbers nested under "e2e",
@@ -101,6 +104,7 @@ def _pick_ragged_eos(outs: list[str], tok, budget: int = 128) -> tuple[int, ...]
 
 
 def run_e2e_bench(params) -> tuple[dict, str, object, str]:
+    # returns (metrics, corpus root, the live backend, tokenizer spec)
     from vnsum_tpu.backend.engine import TpuBackend
     from vnsum_tpu.core.config import GenerationConfig, PipelineConfig
     from vnsum_tpu.data.synthesize import synthesize_corpus
@@ -274,29 +278,18 @@ def run_e2e_bench(params) -> tuple[dict, str, object, str]:
             chunks_per_sec / REFERENCE_CHUNKS_PER_SEC, 2
         ),
         "time_budget": budget,
-    }, root, backend.gen_cfg, tok_spec
+    }, root, backend, tok_spec
 
 
-def run_strategy_bench(params, approach: str, root: str, gen_cfg, tok_spec) -> dict:
-    """Summarization-phase timing for a second/third strategy on the SAME
-    corpus + engine weights (VERDICT r2 #5): 4 docs, summarize-only — the
-    reference's comparable numbers are its summarization records
-    (BASELINE.md: iterative llama3.2:3b 20.0 s/doc; hierarchical phi4:14b
-    211 s/doc)."""
-    from vnsum_tpu.backend.engine import TpuBackend
+def run_strategy_bench(backend, approach: str, root: str, tok_spec) -> dict:
+    """Summarization-phase timing for the other strategies on the SAME
+    corpus + engine + compiled programs (VERDICT r2 #5): 4 docs,
+    summarize-only — the reference's comparable numbers are its
+    summarization records (BASELINE.md: iterative llama3.2:3b 20.0 s/doc;
+    hierarchical phi4:14b 211 s/doc)."""
     from vnsum_tpu.core.config import PipelineConfig
-    from vnsum_tpu.models import llama32_3b
     from vnsum_tpu.pipeline.runner import PipelineRunner
 
-    backend = TpuBackend(
-        model_config=llama32_3b(max_seq_len=8448),
-        tokenizer=tok_spec,
-        params=params,
-        batch_size=8,
-        max_new_tokens=128,
-        quantize=True,
-    )
-    backend.gen_cfg = gen_cfg
     cfg = PipelineConfig(
         approach=approach,
         models=["llama3.2-3b"],
@@ -328,7 +321,7 @@ def run_strategy_bench(params, approach: str, root: str, gen_cfg, tok_spec) -> d
         "llm_calls": sum(d.llm_calls for d in rec.processing_details),
         "seconds": round(elapsed, 1),
         "docs_per_min": round(docs / (elapsed / 60), 2) if docs else 0.0,
-        "compactions": backend.stats.compactions,
+        "compactions": backend.stats.compactions,  # cumulative engine stat
     }
     print(f"{approach} bench: {out}", file=sys.stderr)
     if not docs:
@@ -363,12 +356,17 @@ def main() -> int:
 
     gc.collect()
 
-    e2e_res, corpus_root, gen_cfg, tok_spec = run_e2e_bench(params)
+    # ONE engine (weights already quantized, programs already compiled)
+    # serves the e2e run and all three extra strategy phases
+    e2e_res, corpus_root, e2e_backend, tok_spec = run_e2e_bench(params)
     iter_res = run_strategy_bench(
-        params, "iterative", corpus_root, gen_cfg, tok_spec
+        e2e_backend, "iterative", corpus_root, tok_spec
     )
     hier_res = run_strategy_bench(
-        params, "mapreduce_hierarchical", corpus_root, gen_cfg, tok_spec
+        e2e_backend, "mapreduce_hierarchical", corpus_root, tok_spec
+    )
+    crit_res = run_strategy_bench(
+        e2e_backend, "mapreduce_critique", corpus_root, tok_spec
     )
 
     chunks_per_sec = map_res["chunks_per_sec"]
@@ -382,6 +380,7 @@ def main() -> int:
                 "e2e": e2e_res,
                 "e2e_iterative": iter_res,
                 "e2e_hierarchical": hier_res,
+                "e2e_critique": crit_res,
             }
         )
     )

@@ -111,7 +111,12 @@ def main() -> int:
             rec["per_model"][model]["rougeL"] = round(
                 ev["rouge_scores"]["rougeL_f1"], 4
             )
-        ok += r.get("successful", 0) == args.docs
+        # an evidence artifact must be COMPLETE: summarization succeeded
+        # for every doc AND the evaluation pass produced its metrics
+        ok += (
+            r.get("successful", 0) == args.docs
+            and "rougeL" in rec["per_model"][model]
+        )
     if ok != len(cfg.models):
         raise RuntimeError(f"sweep incomplete: {rec['per_model']}")
 
