@@ -186,11 +186,9 @@ class TpuBackend:
 
         if params is None:
             t0 = time.time()
-            # jit the init: eager per-leaf dispatch through the device tunnel
-            # costs minutes for a 3B tree; one compiled program is seconds
-            params = jax.jit(partial(init_params, cfg=self.cfg))(
-                jax.random.key(seed)
-            )
+            from ..models import jitted_init
+
+            params = jitted_init(init_params, self.cfg, seed)
             logger.info("initialized random params in %.1fs", time.time() - t0)
         if quantize:
             from ..models.quant import is_quantized, quantize_params

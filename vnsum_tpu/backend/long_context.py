@@ -423,9 +423,9 @@ class LongContextBackend:
         self._fns: dict = {}
         self.quantize_kv = bool(quantize_kv)
         if params is None:
-            params = jax.jit(partial(init_params, cfg=self.cfg))(
-                jax.random.key(seed)
-            )
+            from ..models import jitted_init
+
+            params = jitted_init(init_params, self.cfg, seed)
         if quantize:
             from ..models.quant import is_quantized, quantize_params
 

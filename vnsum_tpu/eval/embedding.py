@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..models import jitted_init
 from ..models.encoder import (
     EncoderConfig,
     encode,
@@ -56,9 +57,9 @@ class EmbeddingModel:
                 f"vocab ({self.cfg.vocab_size}); ids would clamp to garbage "
                 "embeddings — use an EncoderConfig sized for this tokenizer"
             )
-        self.params = params if params is not None else init_encoder_params(
-            jax.random.key(seed), self.cfg
-        )
+        if params is None:
+            params = jitted_init(init_encoder_params, self.cfg, seed)
+        self.params = params
         # BERT-family tokenizers carry [CLS]/[SEP]; pretrained encoders were
         # trained with them, so wrap every sequence the way
         # sentence-transformers does (mean pooling then includes both, per

@@ -15,6 +15,20 @@ from .llama import (
 )
 from .sampling import sample_logits
 
+
+def jitted_init(init_fn, cfg, seed: int = 0):
+    """Run a param-init function as ONE compiled program.
+
+    Eager per-leaf dispatch through the device tunnel costs minutes for a
+    3B tree (and seconds even for the tiny eval encoder); a jitted init is
+    a single cacheable program. Shared by the generation engine, the
+    long-context backend, and the evaluation embedder."""
+    import functools
+
+    import jax
+
+    return jax.jit(functools.partial(init_fn, cfg=cfg))(jax.random.key(seed))
+
 # model name -> config factory (names match the reference's Ollama tags where
 # an equivalent open-weights architecture exists)
 MODEL_REGISTRY = {
@@ -34,6 +48,7 @@ MODEL_REGISTRY = {
 }
 
 __all__ = [
+    "jitted_init",
     "LlamaConfig",
     "forward",
     "init_kv_cache",
