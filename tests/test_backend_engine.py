@@ -312,10 +312,86 @@ def test_sampled_batches_draw_fresh_randomness():
     assert c.generate(["một văn bản"], config=gen.with_(seed=99)) != first
 
 
+def test_sampling_vocab_keeps_terminators_sampleable():
+    """ADVICE r3 (medium): the decodable-vocab cap must not mask EOS. For
+    ByteTokenizer (eos=257 above the 256 decodable bytes) the sampling limit
+    extends to cover the terminators, with the text-invisible ids between
+    blocked."""
+    from vnsum_tpu.backend.base import sampling_vocab
+    from vnsum_tpu.text.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    limit, allowed = sampling_vocab(tok, 384, (tok.eos_id,))
+    assert limit == 258
+    assert allowed is not None and allowed.shape == (258,)
+    assert allowed[:256].all()      # raw bytes stay sampleable
+    assert not allowed[256]         # BOS blocked (text-invisible)
+    assert allowed[257]             # EOS sampleable
+
+    # custom stop tokens extend the limit the same way
+    limit2, allowed2 = sampling_vocab(tok, 384, (tok.eos_id, 300))
+    assert limit2 == 301 and allowed2[300] and not allowed2[258:300].any()
+
+    # HF-style tokenizer (decodable == head) needs no mask at all
+    class Full:
+        vocab_size = 512
+
+    assert sampling_vocab(Full(), 512, (511,)) == (512, None)
+
+
+def test_sampling_vocab_warns_on_unsampleable_terminator(caplog):
+    """ADVICE r3 (low): a terminator at/above the model head can never fire —
+    that must be loud, not a silent run-to-budget."""
+    import logging
+
+    from vnsum_tpu.backend.base import sampling_vocab
+    from vnsum_tpu.text.tokenizer import ByteTokenizer
+
+    from vnsum_tpu.backend import base as backend_base
+
+    backend_base._warned_unsampleable.clear()
+    with caplog.at_level(logging.WARNING, logger="vnsum.backend"):
+        limit, allowed = sampling_vocab(ByteTokenizer(), 200, (257,))
+        # per-bucket program rebuilds must not repeat the warning
+        sampling_vocab(ByteTokenizer(), 200, (257,))
+    assert caplog.text.count("terminator ids [257]") == 1
+    assert limit == 200 and allowed is None  # decodable clamps to the head
+
+
+def test_native_eos_terminates_sampled_decode():
+    """A ByteTokenizer model CAN now stop early on its native EOS: over a
+    sampled batch with a real budget, at least one row must draw EOS=257 and
+    terminate before max_new (pre-fix this was impossible — eos sat above
+    the decodable cap and every row always burned the full budget)."""
+    from vnsum_tpu.backend.engine import TpuBackend
+
+    be = TpuBackend(
+        model_config=tiny_llama(max_seq_len=256), tokenizer="byte",
+        batch_size=8, max_new_tokens=128, seed=0, continuous=False,
+    )
+    # near-uniform random-init logits give p(EOS) ~ 1/258 per draw; over
+    # 16 rows x 128 steps the no-early-stop probability is ~3e-4, and the
+    # pinned seeds make each run deterministic besides
+    prompts = [f"văn bản số {i}" for i in range(8)]
+    stopped_short = False
+    for seed in (3, 4):
+        before = be.stats.generated_tokens
+        outs = be.generate(
+            prompts, config=GenerationConfig(temperature=1.0, seed=seed)
+        )
+        assert len(outs) == 8
+        stopped_short |= (be.stats.generated_tokens - before) < 8 * 128
+    assert stopped_short
+
+
 def test_sampling_restricted_to_tokenizer_vocab():
     """A model head larger than the tokenizer vocab must never emit ids the
     tokenizer cannot decode (they would vanish at detok, yielding empty
-    summaries — round-3 bench regression)."""
+    summaries — round-3 bench regression). Checked on the RAW id stream of
+    the compiled program: every sampled id must be a raw byte, a terminator,
+    or pad — never BOS or the [258, 2048) filler range. (EOS itself became
+    sampleable in the ADVICE-r3 fix, so string-length heuristics no longer
+    prove anything: a row may legitimately stop at any step.)"""
     from vnsum_tpu.backend.engine import TpuBackend
 
     cfg = tiny_llama(vocab_size=2048)  # model vocab >> byte-tokenizer vocab
@@ -323,12 +399,10 @@ def test_sampling_restricted_to_tokenizer_vocab():
         model_config=cfg, tokenizer="byte", batch_size=2, max_new_tokens=16,
         seed=0, continuous=False,
     )
-    outs = be.generate(
-        ["văn bản", "hai"],
-        config=GenerationConfig(temperature=1.0, seed=9),
-    )
-    # sampled ids stay in [0, 256) — raw bytes — so EVERY row decodes to
-    # its full 16-byte stream (an undecodable id anywhere would shorten or
-    # empty it; whitespace-only streams are the only (vanishing) exception)
-    assert all(o for o in outs), outs
-    assert all(len(o.encode("utf-8", "ignore")) >= 8 for o in outs), outs
+    gen = GenerationConfig(temperature=1.0, seed=9)
+    encoded = [be.tok.encode(p, add_bos=True) for p in ["văn bản", "hai"]]
+    tokens, pads, B, S = be._pack_group([0, 1], encoded, 16)
+    fn = be._get_fn(B, S, 16, gen)
+    out = np.asarray(fn(be.params, tokens, pads, 123))
+    sampleable = set(range(256)) | {be.tok.eos_id, be.tok.pad_id}
+    assert set(np.unique(out).tolist()) <= sampleable, np.unique(out)

@@ -1,0 +1,52 @@
+"""enable_compilation_cache: idempotent per directory, re-points on a new
+explicit directory, honors the "off"/""/"0" opt-outs, and explicit choices
+(enable OR disable) survive the library-internal no-arg ensure-enabled calls
+(ADVICE r3: first-call-wins previously swallowed later explicit config)."""
+import jax
+import pytest
+
+from vnsum_tpu.core import jax_cache
+
+
+@pytest.fixture()
+def _restore_cache_config():
+    before_state = jax_cache._state
+    before_cfg = jax.config.jax_compilation_cache_dir
+    yield
+    jax_cache._state = before_state
+    jax.config.update("jax_compilation_cache_dir", before_cfg)
+
+
+def test_repoints_on_new_explicit_dir(tmp_path, _restore_cache_config):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    assert jax_cache.enable_compilation_cache(a) is True
+    assert jax.config.jax_compilation_cache_dir == a
+    # same dir: idempotent no-op
+    assert jax_cache.enable_compilation_cache(a) is True
+    # different explicit dir: re-points instead of being silently ignored
+    assert jax_cache.enable_compilation_cache(b) is True
+    assert jax.config.jax_compilation_cache_dir == b
+    # library-internal no-arg ensure-enabled calls must NOT re-point an
+    # active cache back to the env/default resolution
+    assert jax_cache.enable_compilation_cache() is True
+    assert jax.config.jax_compilation_cache_dir == b
+
+
+def test_explicit_disable_survives_no_arg_calls(tmp_path, _restore_cache_config):
+    a = str(tmp_path / "a")
+    assert jax_cache.enable_compilation_cache(a) is True
+    assert jax_cache.enable_compilation_cache("off") is False
+    assert jax.config.jax_compilation_cache_dir is None
+    # backend construction's ensure-enabled call must not undo the opt-out
+    assert jax_cache.enable_compilation_cache() is False
+    assert jax.config.jax_compilation_cache_dir is None
+    # a later explicit dir re-enables
+    assert jax_cache.enable_compilation_cache(a) is True
+    assert jax.config.jax_compilation_cache_dir == a
+
+
+@pytest.mark.parametrize("val", ["", "0", "off"])
+def test_every_documented_disable_value_disables(val, _restore_cache_config):
+    jax_cache._state = None
+    assert jax_cache.enable_compilation_cache(val) is False
+    assert jax_cache.enable_compilation_cache() is False

@@ -88,6 +88,78 @@ def decodable_vocab_limit(tok, model_vocab_size: int) -> int:
     return min(model_vocab_size, tok_limit or model_vocab_size)
 
 
+_warned_unsampleable: set = set()
+
+
+def sampling_vocab(tok, model_vocab_size: int, terminators=()):
+    """(limit, allowed-or-None) restriction the engines apply to logits
+    before sampling.
+
+    ``limit`` extends :func:`decodable_vocab_limit` just far enough to cover
+    every terminator id (EOS must stay *sampleable*, or a model trained to
+    emit it — e.g. a ByteTokenizer fixture where eos_id=257 sits above the
+    256 decodable bytes — can never stop early and always burns the full
+    max_new budget). ``allowed`` is a bool [limit] numpy mask, or None when
+    every id below ``limit`` is fair game (the common HF case); ids in
+    [decodable, limit) that are not terminators stay blocked so sampling
+    cannot emit text-invisible filler tokens.
+
+    Terminators at or above the model head (e.g. a special id above a
+    padded-head Qwen3's 151936 logits) are physically unsampleable —
+    warn loudly instead of silently never terminating.
+    """
+    import numpy as np
+
+    decodable = decodable_vocab_limit(tok, model_vocab_size)
+    terms = sorted({int(t) for t in terminators})
+    dropped = [t for t in terms if not 0 <= t < model_vocab_size]
+    # the engines rebuild programs per (B, S, max_new) bucket; the condition
+    # is a per-backend constant, so warn once per distinct case, not per
+    # compile (the key is the condition itself, not the tok object)
+    warn_key = (model_vocab_size, decodable, tuple(dropped))
+    if dropped and warn_key not in _warned_unsampleable:
+        _warned_unsampleable.add(warn_key)
+        from ..core.logging import get_logger
+
+        get_logger("vnsum.backend").warning(
+            "terminator ids %s lie outside the model head (vocab %d) and "
+            "can never be sampled; generation will run to max_new unless "
+            "another terminator fires",
+            dropped, model_vocab_size,
+        )
+    terms = [t for t in terms if 0 <= t < model_vocab_size]
+    limit = max([decodable] + [t + 1 for t in terms])
+    if limit == decodable:
+        return limit, None
+    allowed = np.zeros((limit,), dtype=bool)
+    allowed[:decodable] = True
+    allowed[terms] = True
+    return limit, allowed
+
+
+def terminator_ids(tok, gen) -> tuple[int, ...]:
+    """The ONE effective stop-token set both engines use for done detection,
+    sampleability (sampling_vocab), and detok stripping: the tokenizer's
+    native EOS is always a terminator, custom GenerationConfig.eos_ids add
+    to it rather than replace it. A token in only one of those three roles
+    would either leak into text or burn the batch budget on thrown-away
+    tokens — keep the policy in this single place."""
+    return tuple(sorted({tok.eos_id, *gen.eos_ids}))
+
+
+def mask_unsampleable(row_logits, allowed):
+    """Apply a :func:`sampling_vocab` mask to a [B, limit] logits slice —
+    blocked ids get float32 min so neither argmax nor categorical can pick
+    them. ``allowed=None`` (everything decodable) is the identity. ONE copy
+    shared by the one-chip and long-context engines so the masking semantics
+    cannot drift between them."""
+    if allowed is None:
+        return row_logits
+    import jax.numpy as jnp
+
+    return jnp.where(allowed, row_logits, jnp.finfo(jnp.float32).min)
+
+
 def resolve_max_new(
     max_new_tokens: int | None, config, backend_default: int
 ) -> int:

@@ -27,10 +27,12 @@ import numpy as np
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
 from .base import (
-    decodable_vocab_limit,
     fold_seed,
     left_pad_batch,
+    mask_unsampleable,
     resolve_max_new,
+    sampling_vocab,
+    terminator_ids,
     trim_to_eos,
 )
 from ..core.profiling import annotate
@@ -228,11 +230,19 @@ class TpuBackend:
         cannot drift."""
         cfg = self.cfg
         C = S + max_new
-        eos = jnp.asarray(
-            list(gen.eos_ids) or [self.tok.eos_id], dtype=jnp.int32
+        terminators = terminator_ids(self.tok, gen)
+        eos = jnp.asarray(terminators, dtype=jnp.int32)
+        # never sample a token the tokenizer cannot render as text — but
+        # keep every terminator sampleable even when it sits above the
+        # decodable range (ByteTokenizer's eos_id=257 >= 256 raw bytes)
+        vocab_limit, allowed = sampling_vocab(
+            self.tok, cfg.vocab_size, terminators
         )
-        # never sample a token the tokenizer cannot render as text
-        vocab_limit = decodable_vocab_limit(self.tok, cfg.vocab_size)
+        allowed_dev = None if allowed is None else jnp.asarray(allowed)
+
+        def restrict(row_logits):  # [B, vocab_limit]
+            return mask_unsampleable(row_logits, allowed_dev)
+
         pad_id = self.tok.pad_id
         use_flash, use_flash_decode = self._decode_settings(S, C)
         mesh = self.mesh
@@ -286,7 +296,7 @@ class TpuBackend:
                 lambda u: jax.random.fold_in(jax.random.fold_in(base, u), 0)
             )(uids0)
             first = sample_logits_rows(
-                logits[:, -1, :vocab_limit], keys0,
+                restrict(logits[:, -1, :vocab_limit]), keys0,
                 gen.temperature, gen.top_k, gen.top_p,
             )
             # all-pad dummy rows (batch bucketing filler) start done, else
@@ -343,7 +353,7 @@ class TpuBackend:
                     )
                 )(uids)
                 nxt = sample_logits_rows(
-                    logits[:, -1, :vocab_limit], step_keys,
+                    restrict(logits[:, -1, :vocab_limit]), step_keys,
                     gen.temperature, gen.top_k, gen.top_p,
                 )
                 return (t + 1, nxt, cache, done, out)

@@ -39,10 +39,12 @@ from jax.sharding import PartitionSpec as P
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
 from .base import (
-    decodable_vocab_limit,
     fold_seed,
     left_pad_batch,
+    mask_unsampleable,
     resolve_max_new,
+    sampling_vocab,
+    terminator_ids,
     trim_to_eos,
 )
 from ..models.llama import (
@@ -288,6 +290,7 @@ def generate_long_tokens(
     seed: int = 0,
     quantize_kv: bool = False,
     vocab_limit: int = 0,
+    vocab_allowed=None,
 ) -> jax.Array:
     """Traceable end-to-end long-context generation; returns [B, max_new].
 
@@ -298,8 +301,14 @@ def generate_long_tokens(
     B, S = tokens.shape
     eos = jnp.asarray(list(eos_ids), dtype=jnp.int32)
     # 0 = full model vocab; a smaller tokenizer vocab restricts sampling to
-    # decodable ids (same rationale as engine.py's vocab_limit)
+    # decodable ids (same rationale as engine.py's vocab_limit). The bool
+    # ``vocab_allowed`` mask keeps terminators above the decodable range
+    # sampleable while blocking text-invisible filler ids (base.sampling_vocab)
     V = vocab_limit or None
+    allowed = None if vocab_allowed is None else jnp.asarray(vocab_allowed)
+
+    def restrict(row_logits):  # [B, V]
+        return mask_unsampleable(row_logits, allowed)
 
     last_logits, prefill_cache = long_prefill(
         params, cfg, tokens, pad_lens, mesh
@@ -308,7 +317,9 @@ def generate_long_tokens(
         prefill_cache = quantize_prefill_cache(prefill_cache)
     key = jax.random.key(seed)
     key, sub = jax.random.split(key)
-    first = sample_logits(last_logits[:, :V], sub, temperature, top_k, top_p)
+    first = sample_logits(
+        restrict(last_logits[:, :V]), sub, temperature, top_k, top_p
+    )
     done0 = pad_lens == S  # all-pad filler rows start done
 
     attention = make_long_decode_attention(
@@ -337,7 +348,7 @@ def generate_long_tokens(
         )
         key, sub = jax.random.split(key)
         nxt = sample_logits(
-            logits[:, -1, :V], sub, temperature, top_k, top_p
+            restrict(logits[:, -1, :V]), sub, temperature, top_k, top_p
         )
         return (t + 1, nxt, cache, done, key, out)
 
@@ -518,7 +529,10 @@ class LongContextBackend:
             from ..parallel.sharding import param_shardings
 
             ns = lambda spec: NamedSharding(self.mesh, spec)
-            eos_ids = tuple(gen.eos_ids) or (self.tok.eos_id,)
+            eos_ids = terminator_ids(self.tok, gen)
+            vocab_limit, vocab_allowed = sampling_vocab(
+                self.tok, self.cfg.vocab_size, eos_ids
+            )
 
             def program(params, tokens, pad_lens, seed):
                 return generate_long_tokens(
@@ -527,9 +541,8 @@ class LongContextBackend:
                     temperature=gen.temperature, top_k=gen.top_k,
                     top_p=gen.top_p, seed=seed,
                     quantize_kv=self.quantize_kv,
-                    vocab_limit=decodable_vocab_limit(
-                        self.tok, self.cfg.vocab_size
-                    ),
+                    vocab_limit=vocab_limit,
+                    vocab_allowed=vocab_allowed,
                 )
 
             self._fns[key] = jax.jit(

@@ -14,30 +14,60 @@ from __future__ import annotations
 
 import os
 
-_enabled = False
+# None = never configured; "" = explicitly disabled; else the active dir.
+# The disabled sentinel matters: an explicit opt-out must survive the
+# library-internal no-arg ensure-enabled calls backends make.
+_state: str | None = None
+
+
+def _apply(directory: str | None) -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", directory)
+    if directory is not None:
+        # cache every program that takes meaningful compile time; the tiny
+        # eager helpers stay uncached to keep the directory small
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # JAX binds its cache object at the FIRST cached compile and a run-once
+    # guard then ignores config changes — drop it so the new directory (or
+    # the disable) actually takes effect for subsequent compiles
+    from jax.experimental.compilation_cache import compilation_cache
+
+    compilation_cache.reset_cache()
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> bool:
-    """Idempotently point JAX at a persistent on-disk compilation cache.
+    """Point JAX at a persistent on-disk compilation cache.
 
     Returns True when the cache is active. Resolution order: explicit
     argument > $VNSUM_JAX_CACHE_DIR > ~/.cache/vnsum_jax. The values
     "off"/"0"/"" disable it.
+
+    Calls are idempotent for the same resolved directory. A later call with
+    a DIFFERENT *explicit* cache_dir re-points JAX at it — programs compiled
+    under the old directory stay there, new compiles land in the new one —
+    and an explicit "off" disables it. No-arg calls (the library-internal
+    ensure-enabled calls every device-touching entry point makes) never
+    override an explicit earlier choice, enable or disable.
     """
-    global _enabled
-    if _enabled:
-        return True
-    resolved = cache_dir or os.environ.get(
-        "VNSUM_JAX_CACHE_DIR", os.path.expanduser("~/.cache/vnsum_jax")
+    global _state
+    if cache_dir is None and _state is not None:
+        return _state != ""
+    resolved = (
+        cache_dir
+        if cache_dir is not None
+        else os.environ.get(
+            "VNSUM_JAX_CACHE_DIR", os.path.expanduser("~/.cache/vnsum_jax")
+        )
     )
     if resolved in ("", "0", "off"):
+        if _state not in (None, ""):
+            _apply(None)
+        _state = ""
         return False
-    import jax
-
+    if resolved == _state:
+        return True
     os.makedirs(resolved, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", resolved)
-    # cache every program that takes meaningful compile time; the tiny eager
-    # helpers stay uncached to keep the directory small
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    _enabled = True
+    _apply(resolved)
+    _state = resolved
     return True
