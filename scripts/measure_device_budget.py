@@ -1,0 +1,55 @@
+"""Standalone on-chip run of bench.py's instrumented device-budget phase.
+
+Answers VERDICT r3 weak #2 directly: where do the e2e mapreduce seconds go
+at device level (prefill vs decode vs host phases), with MFU and HBM-roofline
+context — without paying for the full 4-phase bench. Writes
+artifacts/device_budget_r4.json.
+
+Usage:  python scripts/measure_device_budget.py [--docs 4] [--out PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=4)
+    ap.add_argument(
+        "--out", default=str(REPO / "artifacts" / "device_budget_r4.json")
+    )
+    args = ap.parse_args()
+
+    import bench
+    from vnsum_tpu.core.jax_cache import enable_compilation_cache
+    from vnsum_tpu.data.synthesize import synthesize_corpus
+    from vnsum_tpu.models.fixtures import train_bpe_tokenizer
+
+    enable_compilation_cache()
+    root = tempfile.mkdtemp(prefix="vnsum_budget_")
+    synthesize_corpus(
+        f"{root}/corpus", n_docs=args.docs,
+        tokens_per_doc=bench.E2E_WORDS_PER_DOC, summary_tokens=714,
+        seed=7, ragged=0.5,
+    )
+    doc_paths = sorted(pathlib.Path(f"{root}/corpus/doc").glob("*.txt"))
+    hf_tok = train_bpe_tokenizer(
+        (p.read_text(encoding="utf-8") for p in doc_paths), vocab_size=4096
+    )
+    hf_tok.save_pretrained(f"{root}/tok")
+
+    out = bench.run_device_budget(None, root, f"hf:{root}/tok", None)
+    pathlib.Path(args.out).write_text(json.dumps(out, indent=2))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

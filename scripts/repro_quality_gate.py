@@ -1,0 +1,211 @@
+"""Pinned reproduction kit for the reference's quality gate.
+
+The reference's headline quality number is ROUGE-L 0.3053 for mapreduce +
+llama3.2:3b on the VN-LongSum dataset
+(/root/reference/evaluation_results/first_dataset/mapreduce/
+llama3_2_3b_results.json, summary_statistics.rouge_scores). Pretrained 3B
+weights are not present on this host, so the gate cannot be *scored* here —
+this script pins everything else so that on any machine with the weights it
+is ONE command:
+
+    python scripts/repro_quality_gate.py \
+        --weights-dir /path/to/Llama-3.2-3B-Instruct \
+        --docs-dir data_1/doc --summary-dir data_1/summary \
+        --preset vn-longsum --approach mapreduce \
+        --reference-json /path/to/llama3_2_3b_results.json
+
+It runs the full pipeline (summarize → ROUGE/BERTScore/semsim [+ G-Eval
+when a judge is configured]) with the reference's exact knobs, then diffs
+our results JSON against the reference results file FIELD-FOR-FIELD
+(schema must match; numeric deltas reported per metric).
+
+Presets mirror the reference configs verbatim:
+- vn-longsum: run_full_evaluation_pipeline.py:993-1027 (chunk 12000 /
+  overlap 200 / token_max 10000 / max_new 1024; critique raises max_new to
+  2048; truncated uses max_context 16384).
+- law: the second-dataset run's recorded config (evaluation_results/
+  second_dataset/mapreduce/pipeline_results_20250608_022112.json
+  pipeline_info.config: chunk 1200 / overlap 50 / token_max 1000 /
+  max_new 512).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# preset -> PipelineConfig overrides applied ON TOP of approach_defaults()
+PRESETS = {
+    "vn-longsum": {"max_new_tokens": 1024},
+    "law": {
+        "chunk_size": 1200,
+        "chunk_overlap": 50,
+        "token_max": 1000,
+        "max_new_tokens": 512,
+    },
+}
+
+
+def schema_diff(reference: dict, ours: dict, path: str = "") -> dict:
+    """Field-for-field comparison of nested stat dicts: every reference key
+    must exist in ours with the same type shape; numeric pairs get deltas."""
+    missing: list[str] = []
+    extra: list[str] = []
+    mismatched: list[str] = []
+    deltas: dict[str, dict] = {}
+
+    def walk(ref, got, p):
+        if isinstance(ref, dict):
+            if not isinstance(got, dict):
+                missing.append(p or "<root>")
+                return
+            for k, v in ref.items():
+                walk(v, got.get(k, _MISSING), f"{p}.{k}" if p else k)
+            for k in got:
+                if k not in ref:
+                    extra.append(f"{p}.{k}" if p else k)
+        elif got is _MISSING:
+            missing.append(p)
+        elif isinstance(ref, (int, float)) and isinstance(got, (int, float)):
+            deltas[p] = {
+                "reference": ref,
+                "ours": got,
+                "delta": round(float(got) - float(ref), 6),
+            }
+        elif isinstance(ref, (int, float)) or isinstance(got, (int, float)):
+            # one side numeric, the other not (string/null/dict) — a
+            # corrupted metric must fail the gate, not slip between buckets
+            mismatched.append(f"{p} (ours: {type(got).__name__})")
+
+    _MISSING = object()
+    walk(reference, ours, path)
+    return {
+        "schema_ok": not missing and not mismatched,
+        "missing_fields": missing,
+        "type_mismatches": mismatched,
+        "extra_fields": extra,
+        "metric_deltas": deltas,
+    }
+
+
+def build_config(args) -> "PipelineConfig":
+    from vnsum_tpu.core.config import PipelineConfig, approach_defaults
+
+    overrides = dict(approach_defaults(args.approach))
+    overrides.update(PRESETS[args.preset])
+    if args.max_new_tokens:
+        overrides["max_new_tokens"] = args.max_new_tokens
+    cfg = PipelineConfig(
+        approach=args.approach,
+        models=[args.model],
+        backend=args.backend,
+        docs_dir=args.docs_dir,
+        summary_dir=args.summary_dir,
+        generated_summaries_dir=str(Path(args.out) / "generated_summaries"),
+        results_dir=str(Path(args.out) / "results"),
+        logs_dir=str(Path(args.out) / "logs"),
+        max_samples=args.max_samples,
+        batch_size=args.batch_size,
+        quantize=args.quantize and args.backend == "tpu",
+        weights_dir=args.weights_dir if args.backend == "tpu" else None,
+        tree_json_path=args.tree_json or str(
+            Path(args.docs_dir).parent / "document_tree.json"
+        ),
+        **overrides,
+    )
+    if args.embedding_dir:
+        cfg.evaluation.embedding_dir = args.embedding_dir
+    if args.include_llm_eval:
+        cfg.evaluation.include_llm_eval = True
+    if args.judge_backend:
+        cfg.evaluation.include_llm_eval = True
+        cfg.evaluation.judge_backend = args.judge_backend
+    return cfg
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--weights-dir", help="local HF checkpoint dir (3B gate)")
+    ap.add_argument("--docs-dir", required=True)
+    ap.add_argument("--summary-dir", required=True)
+    ap.add_argument("--approach", default="mapreduce",
+                    choices=["mapreduce", "iterative", "truncated",
+                             "mapreduce_critique", "mapreduce_hierarchical"])
+    ap.add_argument("--preset", default="vn-longsum", choices=sorted(PRESETS))
+    ap.add_argument("--model", default="llama3.2-3b")
+    ap.add_argument("--backend", default="tpu",
+                    help="tpu (default) or fake (CI smoke of this kit)")
+    ap.add_argument("--reference-json",
+                    help="reference *_results.json to diff field-for-field")
+    ap.add_argument("--out", default="repro_gate_out")
+    ap.add_argument("--max-samples", type=int)
+    ap.add_argument("--max-new-tokens", type=int)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--quantize", action="store_true", default=True)
+    ap.add_argument("--no-quantize", dest="quantize", action="store_false")
+    ap.add_argument("--embedding-dir",
+                    help="local all-MiniLM-L6-v2 dir for BASELINE-comparable "
+                         "BERTScore/semsim")
+    ap.add_argument("--include-llm-eval", action="store_true")
+    ap.add_argument("--judge-backend",
+                    help="local Backend spec for an offline G-Eval judge "
+                         "(implies --include-llm-eval)")
+    ap.add_argument("--tree-json")
+    args = ap.parse_args(argv)
+
+    if args.backend == "tpu" and not args.weights_dir:
+        ap.error("--weights-dir is required with backend=tpu (the gate is a "
+                 "pretrained-weights number); use --backend fake for a "
+                 "plumbing smoke test")
+
+    from vnsum_tpu.pipeline.runner import PipelineRunner, model_name_safe
+
+    cfg = build_config(args)
+    runner = PipelineRunner(cfg)
+    results = runner.run()
+
+    rec = results.summarization.get(args.model, {})
+    if rec.get("successful", 0) == 0:
+        print(json.dumps({"ok": False, "error": "no documents summarized"}))
+        return 1
+
+    ours_path = (
+        Path(cfg.results_dir) / f"{model_name_safe(args.model)}_results.json"
+    )
+    ours = json.loads(ours_path.read_text())
+    verdict: dict = {
+        "ok": True,
+        "approach": args.approach,
+        "preset": args.preset,
+        "docs_ok": rec.get("successful"),
+        "results_json": str(ours_path),
+        "summary_statistics": ours.get("summary_statistics"),
+    }
+    if args.reference_json:
+        ref = json.loads(Path(args.reference_json).read_text())
+        # second-dataset files nest stats under results.evaluation.<model>
+        ref_stats = ref.get("summary_statistics")
+        if ref_stats is None:
+            ev = ref.get("results", {}).get("evaluation", {})
+            model_key = next(iter(ev), None)
+            ref_stats = (ev.get(model_key, {}) or {}).get("metrics", {}).get(
+                "summary_statistics"
+            )
+        if ref_stats is None:
+            print(json.dumps({"ok": False,
+                              "error": "no summary_statistics in reference"}))
+            return 1
+        verdict["diff"] = schema_diff(
+            ref_stats, ours.get("summary_statistics", {})
+        )
+        verdict["ok"] = verdict["diff"]["schema_ok"]
+    print(json.dumps(verdict, ensure_ascii=False))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -312,6 +312,27 @@ def test_sampled_batches_draw_fresh_randomness():
     assert c.generate(["một văn bản"], config=gen.with_(seed=99)) != first
 
 
+def test_instrument_mode_matches_oneshot_and_records_budget():
+    """instrument=True must be observability-only: identical outputs to the
+    one-shot program (same _make_parts bodies), with per-phase device times
+    and per-dispatch {B, S, steps} records filled in."""
+    from vnsum_tpu.backend.engine import TpuBackend
+
+    cfg = tiny_llama(max_seq_len=128)
+    kw = dict(model_config=cfg, batch_size=4, max_new_tokens=8, seed=3)
+    plain = TpuBackend(**kw)
+    inst = TpuBackend(instrument=True, **kw)
+    prompts = ["văn bản một", "hai dài hơn một chút", "ba", "bốn"]
+    assert plain.generate(prompts) == inst.generate(prompts)
+    st = inst.stats
+    assert st.phase_seconds.get("prefill", 0) > 0
+    assert st.phase_seconds.get("decode", 0) > 0
+    assert "tokenize_host" in st.phase_seconds
+    assert st.compactions == 0  # instrument pins the batch
+    (d,) = st.dispatches
+    assert d["B"] == 4 and d["steps"] <= 8 and d["decode_s"] >= 0
+
+
 def test_sampling_vocab_keeps_terminators_sampleable():
     """ADVICE r3 (medium): the decodable-vocab cap must not mask EOS. For
     ByteTokenizer (eos=257 above the 256 decodable bytes) the sampling limit

@@ -124,3 +124,24 @@ def test_quantized_param_specs_match_tree():
         jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
     ):
         assert leaf.ndim == len(spec), (leaf.shape, spec)
+
+
+def test_init_params_quantized_runs_engine():
+    """Direct-int8 random init (no bf16 tree ever resident — the only way a
+    14B fits one chip) must produce the exact quantize_params layout and
+    drive the engine end to end."""
+    import jax
+
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.models import jitted_init, tiny_llama
+    from vnsum_tpu.models.quant import init_params_quantized, is_quantized
+
+    cfg = tiny_llama(max_seq_len=128)
+    params = jitted_init(init_params_quantized, cfg, seed=1)
+    assert is_quantized(params)
+    assert params["layers"]["wq"]["q"].dtype == jax.numpy.int8
+    be = TpuBackend(
+        model_config=cfg, params=params, batch_size=2, max_new_tokens=6
+    )
+    outs = be.generate(["văn bản", "hai"])
+    assert len(outs) == 2 and all(isinstance(o, str) for o in outs)

@@ -55,6 +55,48 @@ def test_decode_kernel_ignores_past_fill_garbage():
     np.testing.assert_allclose(np.asarray(clean), np.asarray(poisoned))
 
 
+@pytest.mark.parametrize("win,fill", [(1, 37), (8, 37), (16, 8), (64, 37)])
+def test_decode_windowed_matches_dense(win, fill):
+    """Sliding-window decode: kernel vs dense with the slot-space window
+    (k_slot > fill - win), including win > fill (window not yet binding)."""
+    L, B, KV, C, H, hd = 1, 2, 2, 64, 4, 128
+    q, cache = make_case(L, B, KV, C, H, hd, seed=13)
+    pad = jnp.asarray([0, 3], jnp.int32)
+    mask = decode_attention_mask(pad, fill, C) & (
+        jnp.arange(C)[None, None, :] > fill - win
+    )
+    dense = _attention(q, cache["k"][0], cache["v"][0], mask, H // KV)
+    kernel = flash_decode_attention(
+        q, cache, 0, pad, fill, H // KV, jnp.int32(win),
+        block_k=16, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(kernel), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_decode_windowed_ignores_below_window_garbage():
+    """Below-window slots must not leak in even with huge values — they are
+    DMA-clamped away, not just masked."""
+    L, B, KV, C, H, hd = 1, 1, 1, 64, 2, 128
+    q, cache = make_case(L, B, KV, C, H, hd, seed=7)
+    fill, win = 40, 8
+    poisoned = {
+        "k": cache["k"].at[:, :, :, : fill - win + 1, :].set(30.0),
+        "v": cache["v"].at[:, :, :, : fill - win + 1, :].set(1e9),
+    }
+    pad = jnp.zeros((B,), jnp.int32)
+    clean = flash_decode_attention(
+        q, cache, 0, pad, fill, H // KV, jnp.int32(win),
+        block_k=8, interpret=True,
+    )
+    dirty = flash_decode_attention(
+        q, poisoned, 0, pad, fill, H // KV, jnp.int32(win),
+        block_k=8, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(clean), np.asarray(dirty))
+
+
 def quantize_case(cache):
     """Round-trip the float case through the int8 cache format."""
     from vnsum_tpu.models.llama import _quantize_kv

@@ -74,6 +74,48 @@ def test_flash_multiple_k_blocks():
     )
 
 
+@pytest.mark.parametrize("win", [1, 8, 24])
+def test_flash_windowed_matches_dense(win):
+    """Sliding-window clamp (Gemma local layers): kernel vs the dense path's
+    slot-space window mask (models.llama._block: k_slot > q_slot - window),
+    on shapes where below-window whole blocks get clamped/elided."""
+    L, B, S, C, H, KV, hd = 1, 2, 45, 61, 2, 1, 128
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=9)
+    pads = [0, 5]
+    pad = jnp.asarray(pads, jnp.int32)
+    mask = prefill_attention_mask(pad, S, C)
+    in_window = jnp.arange(C)[None, :] > jnp.arange(S)[:, None] - win
+    dense = _attention(
+        q, cache["k"][0], cache["v"][0], mask & in_window[None], H // KV
+    )
+    flash = flash_prefill_attention(
+        q, cache, 0, pad, H // KV, jnp.int32(win),
+        block_q=16, block_k=16, interpret=True,
+    )
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(dense)[b, pads[b]:],
+            np.asarray(flash)[b, pads[b]:],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_flash_window_zero_is_global():
+    """window=0 must be bit-identical to the no-window call (global layers
+    share the compiled program with sliding ones)."""
+    L, B, S, C, H, KV, hd = 1, 1, 45, 61, 2, 1, 128
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=4)
+    pad = jnp.asarray([5], jnp.int32)
+    a = flash_prefill_attention(
+        q, cache, 0, pad, H // KV, block_q=16, block_k=16, interpret=True
+    )
+    b = flash_prefill_attention(
+        q, cache, 0, pad, H // KV, jnp.int32(0),
+        block_q=16, block_k=16, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_supports_flash():
     assert supports_flash(1024, 1152, 128)
     assert supports_flash(1001, 1153, 256)  # any S/C via ceil-div grids

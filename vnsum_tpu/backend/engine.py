@@ -75,6 +75,18 @@ class EngineStats:
     compactions: int = 0
     compacted_batch_sizes: list = field(default_factory=list)
     by_bucket: dict = field(default_factory=dict)
+    # host-phase wall clock (always on: the timers wrap pure-host work) plus,
+    # under instrument=True, the device phases "prefill"/"decode" measured by
+    # result-fetch sync (np.asarray — block_until_ready is unreliable on the
+    # tunnel, PERF.md measurement hygiene)
+    phase_seconds: dict = field(default_factory=dict)
+    # instrument=True: one record per device dispatch {B, S, steps,
+    # prefill_s, decode_s} — enough to reconstruct FLOP and HBM-byte budgets
+    # per batch shape without re-deriving them from logs
+    dispatches: list = field(default_factory=list)
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        self.phase_seconds[name] = self.phase_seconds.get(name, 0.0) + seconds
 
     @property
     def tokens_per_second(self) -> float:
@@ -102,6 +114,7 @@ class TpuBackend:
         segment_tokens: int = 128,
         min_batch: int = 8,
         interpret: bool = False,
+        instrument: bool = False,
     ) -> None:
         from ..core.jax_cache import enable_compilation_cache
 
@@ -114,12 +127,9 @@ class TpuBackend:
         # are data/model-local, so no cross-chip softmax is needed.
         if flash == "auto":
             flash = jax.default_backend() == "tpu"
-        if self.cfg.sliding_window and flash:
-            # the Pallas kernels attend over the whole valid cache; Gemma's
-            # per-layer window needs kernel-side k-range clamping (future
-            # work) — take the dense path, which applies the window mask
-            logger.info("sliding-window config: Pallas kernels disabled")
-            flash = False
+        # sliding-window (Gemma) configs run the kernels too: the per-layer
+        # window is a runtime scalar the kernels clamp their k-range with
+        # (ops/flash_attention.py, ops/decode_attention.py)
         self.flash = bool(flash)
         # int8 KV cache halves decode-attention HBM traffic; the in-kernel
         # dequant needs the Pallas path, so "auto" follows flash AND actual
@@ -130,15 +140,10 @@ class TpuBackend:
         if quantize_kv == "auto":
             quantize_kv = self.flash and kernels_supported
         elif quantize_kv and not (self.flash and kernels_supported):
-            reason = (
-                "sliding-window configs disable the Pallas kernels (no "
-                "window support yet)"
-                if self.cfg.sliding_window
-                else "requires flash=True and head_dim a multiple of 128"
-            )
             raise ValueError(
-                f"quantize_kv=True needs the Pallas kernels: {reason}; the "
-                "dense fallback would dequantize the whole cache per step"
+                "quantize_kv=True needs the Pallas kernels (flash=True and "
+                "head_dim a multiple of 128); the dense fallback would "
+                "dequantize the whole cache per step"
             )
         self.quantize_kv = bool(quantize_kv)
         self.tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
@@ -179,6 +184,18 @@ class TpuBackend:
         self.continuous = bool(continuous)
         self.segment_tokens = max(segment_tokens, 1)
         self.min_batch = max(min_batch, 1)
+        # instrument=True: run the SPLIT prefill + decode programs (same
+        # _make_parts bodies as the one-shot jit, so identical math) with a
+        # result-fetch sync between them, so stats.phase_seconds carries a
+        # real per-phase device-time budget. Decode runs as ONE full-length
+        # segment and compaction is disabled — the only deltas vs the
+        # one-shot program are the extra dispatch boundary and the done
+        # fetch, a few percent of wall clock (artifacts/compaction_ab.json).
+        self.instrument = bool(instrument)
+        if instrument:
+            self.continuous = True
+            self.segment_tokens = 1 << 30      # single full-length segment
+            self.min_batch = max(self.min_batch, batch_size)  # no compaction
         self.stats = EngineStats()
         self._fns: dict[tuple[int, int, int], callable] = {}
         self._seg_fns: dict = {}
@@ -248,6 +265,19 @@ class TpuBackend:
         mesh = self.mesh
         quantize_kv = self.quantize_kv
         interpret = self.interpret
+        if cfg.sliding_window:
+            from ..models.llama import _layer_global_flags
+
+            win_flags = _layer_global_flags(cfg)
+
+            def layer_window(layer_idx):
+                # per-layer runtime scalar: 0 on global layers, else the
+                # config window — one compiled kernel serves both kinds
+                return jnp.where(
+                    win_flags[layer_idx], 0, cfg.sliding_window
+                ).astype(jnp.int32)
+        else:
+            layer_window = lambda layer_idx: None  # noqa: E731
 
         def prefill_part(params, tokens, pad_lens, seed):
             cache = init_kv_cache(cfg, B, C, quantized=quantize_kv)
@@ -275,7 +305,7 @@ class TpuBackend:
                 def prefill_stacked_fn(q, cache, layer_idx):
                     return sharded_flash_prefill(
                         mesh, q, cache, layer_idx, pad_lens, cfg.q_per_kv,
-                        interpret=interpret,
+                        layer_window(layer_idx), interpret=interpret,
                     )
             elif use_flash:
                 from ..ops.flash_attention import flash_prefill_attention
@@ -283,7 +313,7 @@ class TpuBackend:
                 def prefill_stacked_fn(q, cache, layer_idx):
                     return flash_prefill_attention(
                         q, cache, layer_idx, pad_lens, cfg.q_per_kv,
-                        interpret=interpret,
+                        layer_window(layer_idx), interpret=interpret,
                     )
 
             logits, cache = forward(
@@ -332,7 +362,8 @@ class TpuBackend:
                     def stacked_fn(q, cache, layer_idx):
                         return sharded_flash_decode(
                             mesh, q, cache, layer_idx, pad_lens, S + t,
-                            cfg.q_per_kv, interpret=interpret,
+                            cfg.q_per_kv, layer_window(layer_idx),
+                            interpret=interpret,
                         )
                 elif use_flash_decode:
                     from ..ops.decode_attention import flash_decode_attention
@@ -340,7 +371,8 @@ class TpuBackend:
                     def stacked_fn(q, cache, layer_idx):
                         return flash_decode_attention(
                             q, cache, layer_idx, pad_lens, S + t,
-                            cfg.q_per_kv, interpret=interpret,
+                            cfg.q_per_kv, layer_window(layer_idx),
+                            interpret=interpret,
                         )
 
                 logits, cache = forward(
@@ -513,8 +545,16 @@ class TpuBackend:
             rows[row] = i
 
         prefill = self._get_seg_fn("prefill", B, S, max_new, gen)
+        t_pre = time.time()
         with annotate(f"prefill[B={B},S={S}]"):
             cur, cache, done = prefill(self.params, tokens, pads, seed)
+            if self.instrument:
+                # fetch forces the dispatch to completion: [B] bools, the
+                # cheapest output — prefill device time is now bounded
+                np.asarray(done)
+        prefill_s = time.time() - t_pre
+        if self.instrument:
+            self.stats.add_phase("prefill", prefill_s)
         self.stats.batches += 1
         self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
 
@@ -528,7 +568,10 @@ class TpuBackend:
             self._compact_fn = self._make_compact_fn()
         compact = self._compact_fn
 
+        decode_s = 0.0
+        t_h = 0
         while True:
+            t_seg = time.time()
             segment = self._get_seg_fn("segment", B, S, max_new, gen)
             with annotate(f"decode_seg[B={B},S={S}]"):
                 t, cur, cache, done, out = segment(
@@ -536,8 +579,9 @@ class TpuBackend:
                     np.asarray(uid_of_slot, dtype=np.int32), out, pad_dev,
                     seed,
                 )
-            done_h = np.asarray(done)
+            done_h = np.asarray(done)  # fetch = sync; segment time is real
             t_h = int(t)
+            decode_s += time.time() - t_seg
             live = [r for r, orig in enumerate(rows) if orig is not None]
             active = [r for r in live if not done_h[r]]
             if t_h >= max_new or not active:
@@ -576,6 +620,16 @@ class TpuBackend:
                     B, len(active), t_h,
                 )
 
+        if self.instrument:
+            self.stats.add_phase("decode", decode_s)
+            self.stats.dispatches.append(
+                {
+                    "B": B, "S": S, "steps": t_h,
+                    "prefill_s": round(prefill_s, 3),
+                    "decode_s": round(decode_s, 3),
+                }
+            )
+
         out_h = np.asarray(out)
         for r, orig in enumerate(rows):
             if orig is not None and results[orig] is None:
@@ -588,6 +642,7 @@ class TpuBackend:
 
         Shared by the one-shot and continuous paths — their greedy-parity
         guarantee depends on identical bucketing and padding."""
+        t_pack = time.time()
         max_input = self.cfg.max_seq_len - max_new
         data_size = self.mesh.shape.get("data", 1) if self.mesh is not None else 1
         S = _bucket_len(max(len(encoded[i]) for i in group), max_input)
@@ -600,6 +655,7 @@ class TpuBackend:
         tokens, pad_lens = left_pad_batch(
             [encoded[i] for i in group], B, S, self.tok.pad_id
         )
+        self.stats.add_phase("pack_host", time.time() - t_pack)
         return tokens, pad_lens, B, S
 
     def generate(
@@ -623,12 +679,14 @@ class TpuBackend:
 
         max_input = self.cfg.max_seq_len - max_new
         encoded: list[list[int]] = []
+        t_enc = time.time()
         for p in prompts:
             ids = self.tok.encode(p, add_bos=True)
             if len(ids) > max_input:
                 ids = ids[:max_input]
             encoded.append(ids)
             self.stats.prompt_tokens += len(ids)
+        self.stats.add_phase("tokenize_host", time.time() - t_enc)
 
         # group indices by bucketed length, then emit fixed-shape batches
         order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
@@ -639,7 +697,9 @@ class TpuBackend:
         # prefill/segment dispatches cost ~3% on a homogeneous batch).
         # Sampling is compaction-safe: per-row counter-based keys (see
         # _make_parts) make each row's stream independent of batch position
-        continuous = self.continuous and max_new > self.segment_tokens
+        continuous = self.continuous and (
+            self.instrument or max_new > self.segment_tokens
+        )
         for start in range(0, len(order), self.batch_size):
             group = order[start : start + self.batch_size]
             seed = self._next_seed(gen)

@@ -58,6 +58,11 @@ class EvalConfig:
     include_llm_eval: bool = False
     use_openrouter: bool = True
     llm_model: str = "openai/gpt-4o-mini"
+    # local judge: run G-Eval through the Backend protocol instead of an
+    # HTTP endpoint — the offline path for air-gapped hosts. Forms:
+    # "fake" (CI), "ollama:<model>", "tpu:<registry-name>" (random weights —
+    # plumbing/containment only). Takes precedence over API keys.
+    judge_backend: str | None = None
     max_samples: int | None = None
     bert_batch_size: int = 32
 

@@ -69,6 +69,54 @@ def quantize_params(params: dict) -> dict:
     return out
 
 
+def init_params_quantized(key: jax.Array, cfg) -> dict:
+    """Random-init a params tree DIRECTLY in quantize_params' int8 layout.
+
+    The usual path (bf16 init, then on-device quantize) keeps both trees
+    resident — 3x the int8 bytes — which can never fit phi4:14b (~14.2 GB
+    int8) on one 16 GB chip. This builds the int8 tree without a bf16 one
+    ever existing: random int8 weights with a constant ~1/(sqrt(fan_in)*127)
+    scale, so dequantized magnitudes sit in the usual init range. Shapes
+    come from jax.eval_shape over init_params — the two layouts cannot
+    drift. Perf-sweep tool (real memory/compute shape, untrained values);
+    jit via models.jitted_init like init_params.
+    """
+    from .llama import init_params
+
+    shapes = jax.eval_shape(lambda k: init_params(k, cfg), key)
+    n_leaves = len(jax.tree.leaves(shapes, is_leaf=lambda x: x is None))
+    keys = iter(jax.random.split(key, max(n_leaves, 8)))
+
+    def qinit(k, spec, contract_axes):
+        q = jax.random.randint(k, spec.shape, -127, 128, dtype=jnp.int8)
+        fan = 1
+        for a in contract_axes:
+            fan *= spec.shape[a]
+        s_shape = tuple(
+            d for i, d in enumerate(spec.shape) if i not in contract_axes
+        )
+        s = jnp.full(s_shape, (fan ** -0.5) / 127.0, jnp.float32)
+        return {"q": q, "s": s}
+
+    layers = {}
+    for name, spec in shapes["layers"].items():
+        if name in _CONTRACT_AXES:
+            axes = tuple(a + 1 for a in _CONTRACT_AXES[name])
+            layers[name] = qinit(next(keys), spec, axes)
+        else:  # norm vectors
+            layers[name] = jnp.ones(spec.shape, spec.dtype)
+    out = {
+        "embed": qinit(next(keys), shapes["embed"], (1,)),
+        "layers": layers,
+        "final_norm": jnp.ones(
+            shapes["final_norm"].shape, shapes["final_norm"].dtype
+        ),
+    }
+    if "lm_head" in shapes:
+        out["lm_head"] = qinit(next(keys), shapes["lm_head"], (0,))
+    return out
+
+
 def dequantize_params(qparams: dict) -> dict:
     """Inverse transform (tests / round-trip checks)."""
 

@@ -52,6 +52,7 @@ from .flash_attention import _LANES, _NEG
 def _kernel(
     lidx_ref,  # [1] int32 (SMEM) — layer to read
     fill_ref,  # [1] int32 (SMEM) — last valid cache slot (inclusive)
+    win_ref,   # [1] int32 (SMEM) — sliding window; 0 = global
     *refs,
     block_b: int,
     block_k: int,
@@ -75,6 +76,7 @@ def _kernel(
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     fill = fill_ref[0]
+    win = win_ref[0]
 
     @pl.when(j == 0)
     def _init():
@@ -82,9 +84,13 @@ def _kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # blocks wholly past the fill point were never DMA'd (clamped index_map);
-    # skip their compute so the clamped duplicate block isn't double-counted
-    @pl.when(j * block_k <= fill)
+    # blocks wholly past the fill point — or, with a sliding window, wholly
+    # below the window floor — were never DMA'd (clamped index_map); skip
+    # their compute so the clamped duplicate block isn't double-counted
+    @pl.when(
+        (j * block_k <= fill)
+        & ((win == 0) | (j * block_k + block_k - 1 >= fill - win + 1))
+    )
     def _compute():
         G = q_ref.shape[2]
         hd = q_ref.shape[3]
@@ -107,6 +113,8 @@ def _kernel(
             jnp.int32, (BKV, 1, block_k), 2
         )
         mask = (k_pos >= pads_ref[0]) & (k_pos <= fill)  # [BKV, 1, BK]
+        # window in slot space, matching the dense path's k_slot > fill - win
+        mask = mask & ((win == 0) | (k_pos > fill - win))
         s = jnp.where(mask, s, _NEG)
 
         m_prev = m_ref[:, :, :1]                         # [BKV, G, 1]
@@ -156,12 +164,16 @@ def flash_decode_attention(
     pad_lens: jax.Array,   # [B] int32
     fill: jax.Array,       # scalar int32 — last valid slot (inclusive)
     q_per_kv: int,
+    window: jax.Array | None = None,  # scalar int32; 0/None = global
     *,
     block_k: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
     """Semantics match _attention(q, dequantized cache[layer],
-    mask=pad<=j<=fill); returns [B, 1, H, hd]."""
+    mask=pad<=j<=fill); returns [B, 1, H, hd]. ``window`` > 0 restricts to
+    the last ``window`` slots (Gemma sliding layers): below-window blocks
+    are compute-skipped and DMA-elided like past-fill blocks, so a sliding
+    layer's step reads only ~window worth of cache however long the fill."""
     k_all, v_all = cache["k"], cache["v"]
     quantized = "ks" in cache
     B, S, H, hd = q.shape
@@ -182,20 +194,28 @@ def flash_decode_attention(
     ).reshape(B // bb, bb * KV, 1, bk)
     grid = (B // bb, pl.cdiv(C, bk))
 
-    def kv_index(b, j, lidx, fill, blk=bk):
-        # clamp past-fill blocks onto the fill block: consecutive grid steps
-        # then address the same block and Pallas elides the DMA
-        return (lidx[0], b, 0, jnp.minimum(j, fill[0] // blk), 0)
+    def visible_j(j, fill, win, blk=bk):
+        # clamp past-fill (and, under a window, below-window) blocks onto
+        # the nearest visible block: consecutive grid steps then address the
+        # same block and Pallas elides the DMA
+        lo = jnp.where(
+            win[0] > 0, jnp.maximum(fill[0] - win[0] + 1, 0) // blk, 0
+        )
+        return jnp.clip(j, lo, fill[0] // blk)
 
-    def scale_index(b, j, lidx, fill, blk=bk):
-        return (lidx[0], b, 0, jnp.minimum(j, fill[0] // blk))
+    def kv_index(b, j, lidx, fill, win):
+        return (lidx[0], b, 0, visible_j(j, fill, win), 0)
+
+    def scale_index(b, j, lidx, fill, win):
+        return (lidx[0], b, 0, visible_j(j, fill, win))
 
     in_specs = [
         pl.BlockSpec(
-            (1, bb * KV, q_per_kv, hd), lambda b, j, lidx, fill: (b, 0, 0, 0)
+            (1, bb * KV, q_per_kv, hd),
+            lambda b, j, lidx, fill, win: (b, 0, 0, 0),
         ),
         pl.BlockSpec(
-            (1, bb * KV, 1, bk), lambda b, j, lidx, fill: (b, 0, 0, 0)
+            (1, bb * KV, 1, bk), lambda b, j, lidx, fill, win: (b, 0, 0, 0)
         ),
         pl.BlockSpec((1, bb, KV, bk, hd), kv_index),
         pl.BlockSpec((1, bb, KV, bk, hd), kv_index),
@@ -215,12 +235,12 @@ def flash_decode_attention(
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=grid,
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, bb * KV, q_per_kv, hd),
-                lambda b, j, lidx, fill: (b, 0, 0, 0),
+                lambda b, j, lidx, fill, win: (b, 0, 0, 0),
             ),
             scratch_shapes=[
                 pltpu.VMEM((bb * KV, q_per_kv, hd), jnp.float32),
@@ -233,6 +253,7 @@ def flash_decode_attention(
     )(
         jnp.asarray(layer_idx, jnp.int32).reshape(1),
         jnp.asarray(fill, jnp.int32).reshape(1),
+        jnp.asarray(0 if window is None else window, jnp.int32).reshape(1),
         *operands,
     )
     return out.reshape(B, 1, H, hd)

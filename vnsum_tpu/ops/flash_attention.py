@@ -39,6 +39,7 @@ _LANES = 128
 def _kernel(
     lidx_ref,  # [1] int32 (scalar prefetch, SMEM) — layer to read
     pad_ref,   # [B] int32 (scalar prefetch, SMEM)
+    win_ref,   # [1] int32 (scalar prefetch, SMEM) — sliding window; 0 = global
     *refs,
     block_q: int,
     block_k: int,
@@ -65,6 +66,7 @@ def _kernel(
 
     q_start = i * block_q
     k_start = j * block_k
+    win = win_ref[0]
 
     @pl.when(j == 0)
     def _init():
@@ -72,8 +74,15 @@ def _kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # blocks strictly above the causal diagonal contribute nothing
-    @pl.when(k_start <= q_start + block_q - 1)
+    # blocks strictly above the causal diagonal contribute nothing; with a
+    # sliding window, neither do blocks wholly below the FIRST query row's
+    # window floor (q_start - win + 1 — the least restrictive floor in the
+    # block; later rows re-mask per element). Both sets were never DMA'd —
+    # the index_map clamps them onto an in-range block, see kv_index.
+    @pl.when(
+        (k_start <= q_start + block_q - 1)
+        & ((win == 0) | (k_start + block_k - 1 >= q_start - win + 1))
+    )
     def _compute():
         qb = q_ref[0, 0].astype(jnp.float32)
         kb = k_ref[0, 0, 0].astype(jnp.float32)
@@ -91,8 +100,12 @@ def _kernel(
         pad = pad_ref[b]
         # k_pos <= q_pos also kills the masked tail of a partial K block
         # (those slots have k_pos >= seq_len > any valid q_pos); q_pos of a
-        # partial Q-block tail produces garbage rows the caller never reads
+        # partial Q-block tail produces garbage rows the caller never reads.
+        # Window semantics in SLOT space match the dense path
+        # (models.llama._block: k_slot > q_slot - window) — left pad shifts
+        # q and k slots identically, so the token-space window is preserved
         mask = (k_pos <= q_pos) & (k_pos >= pad) & (q_pos < seq_len)
+        mask = mask & ((win == 0) | (k_pos > q_pos - win))
         s = jnp.where(mask, s, _NEG)
 
         m_prev = m_ref[:, :1]                       # [BQ, 1]
@@ -134,6 +147,7 @@ def flash_prefill_attention(
     layer_idx: jax.Array,  # scalar int32
     pad_lens: jax.Array,   # [B] int32 — left-pad per sequence
     q_per_kv: int,
+    window: jax.Array | None = None,  # scalar int32; 0/None = global
     *,
     block_q: int = 512,
     block_k: int = 512,
@@ -141,7 +155,15 @@ def flash_prefill_attention(
 ) -> jax.Array:
     """Returns [B, S, H, hd]; semantics match _attention with the prefill
     mask (pad_b <= j <= i over cache slots) on the (dequantized) cache layer
-    ``layer_idx``."""
+    ``layer_idx``. ``window`` > 0 additionally restricts each query to the
+    last ``window`` slots (Gemma sliding layers — the per-layer value is a
+    runtime scalar, so one compiled program serves global and local layers).
+
+    K/V blocks a query block can never see — strictly above the causal
+    diagonal, or wholly below the window floor — are both compute-skipped
+    AND DMA-elided: the index_map clamps their block index onto the nearest
+    visible block, and Pallas skips the copy when consecutive grid steps
+    address the same block."""
     k_all, v_all = cache["k"], cache["v"]
     quantized = "ks" in cache
     B, S, H, hd = q.shape
@@ -153,14 +175,24 @@ def flash_prefill_attention(
 
     qt = q.transpose(0, 2, 1, 3)   # [B, H, S, hd]
 
-    def kv_index(b, h, i, j, lidx, pad, g=q_per_kv):
-        return (lidx[0], b, h // g, j, 0)
+    def visible_j(i, j, win):
+        j_hi = (i * bq + bq - 1) // bk  # causal: last block any row sees
+        # window: first block any row sees — the FIRST query row's floor
+        lo = jnp.where(
+            win[0] > 0, jnp.maximum(i * bq - win[0] + 1, 0) // bk, 0
+        )
+        return jnp.clip(j, lo, j_hi)
 
-    def scale_index(b, h, i, j, lidx, pad):
-        return (lidx[0], b, 0, j)
+    def kv_index(b, h, i, j, lidx, pad, win, g=q_per_kv):
+        return (lidx[0], b, h // g, visible_j(i, j, win), 0)
+
+    def scale_index(b, h, i, j, lidx, pad, win):
+        return (lidx[0], b, 0, visible_j(i, j, win))
 
     in_specs = [
-        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j, lidx, pad: (b, h, i, 0)),
+        pl.BlockSpec(
+            (1, 1, bq, hd), lambda b, h, i, j, lidx, pad, win: (b, h, i, 0)
+        ),
         pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
         pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
     ]
@@ -180,11 +212,12 @@ def flash_prefill_attention(
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=grid,
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 1, bq, hd), lambda b, h, i, j, lidx, pad: (b, h, i, 0)
+                (1, 1, bq, hd),
+                lambda b, h, i, j, lidx, pad, win: (b, h, i, 0),
             ),
             scratch_shapes=[
                 pltpu.VMEM((bq, hd), jnp.float32),
@@ -197,6 +230,7 @@ def flash_prefill_attention(
     )(
         jnp.asarray(layer_idx, jnp.int32).reshape(1),
         pad_lens.astype(jnp.int32),
+        jnp.asarray(0 if window is None else window, jnp.int32).reshape(1),
         *operands,
     )
     return out.transpose(0, 2, 1, 3)

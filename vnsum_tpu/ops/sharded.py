@@ -14,8 +14,6 @@ kernel local, let the compiler move everything else.
 """
 from __future__ import annotations
 
-from functools import partial
-
 from jax import shard_map
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
@@ -39,20 +37,25 @@ def sharded_flash_prefill(
     layer_idx,
     pad_lens,
     q_per_kv: int,
+    window=None,
     *,
     interpret: bool = False,
 ):
-    """flash_prefill_attention with q/cache sharded over (data, model)."""
+    """flash_prefill_attention with q/cache sharded over (data, model).
+    ``window`` is a replicated scalar (0/None = global layer)."""
+    import jax.numpy as jnp
+
     fn = shard_map(
-        partial(
-            flash_prefill_attention, q_per_kv=q_per_kv, interpret=interpret
+        lambda qs, cs, li, pads, win: flash_prefill_attention(
+            qs, cs, li, pads, q_per_kv, win, interpret=interpret
         ),
         mesh=mesh,
-        in_specs=(_Q_SPEC, _cache_specs(cache), P(), P(AXES.data)),
+        in_specs=(_Q_SPEC, _cache_specs(cache), P(), P(AXES.data), P()),
         out_specs=_Q_SPEC,
         check_vma=False,
     )
-    return fn(q, cache, layer_idx, pad_lens)
+    win = jnp.asarray(0 if window is None else window, jnp.int32)
+    return fn(q, cache, layer_idx, pad_lens, win)
 
 
 def sharded_flash_decode(
@@ -63,17 +66,22 @@ def sharded_flash_decode(
     pad_lens,
     fill,
     q_per_kv: int,
+    window=None,
     *,
     interpret: bool = False,
 ):
-    """flash_decode_attention with q/cache sharded over (data, model)."""
+    """flash_decode_attention with q/cache sharded over (data, model).
+    ``window`` is a replicated scalar (0/None = global layer)."""
+    import jax.numpy as jnp
+
     fn = shard_map(
-        partial(
-            flash_decode_attention, q_per_kv=q_per_kv, interpret=interpret
+        lambda qs, cs, li, pads, fl, win: flash_decode_attention(
+            qs, cs, li, pads, fl, q_per_kv, win, interpret=interpret
         ),
         mesh=mesh,
-        in_specs=(_Q_SPEC, _cache_specs(cache), P(), P(AXES.data), P()),
+        in_specs=(_Q_SPEC, _cache_specs(cache), P(), P(AXES.data), P(), P()),
         out_specs=_Q_SPEC,
         check_vma=False,
     )
-    return fn(q, cache, layer_idx, pad_lens, fill)
+    win = jnp.asarray(0 if window is None else window, jnp.int32)
+    return fn(q, cache, layer_idx, pad_lens, fill, win)

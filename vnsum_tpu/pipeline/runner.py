@@ -40,10 +40,14 @@ class PipelineRunner:
         config: PipelineConfig,
         backend_factory=None,
         embedding_model=None,
+        llm_judge=None,
     ) -> None:
         self.config = config
         self.backend_factory = backend_factory or self._default_backend_factory
         self.embedding_model = embedding_model
+        # a prebuilt eval.LLMJudge (tests / artifact scripts inject tiny
+        # local judges); None = resolve from EvalConfig in _build_llm_judge
+        self.llm_judge = llm_judge
         self.results = PipelineResults(config=config.to_dict())
         self.tracer = Tracer()
         self.log_path = setup_run_logging(config.logs_dir)
@@ -398,14 +402,20 @@ class PipelineRunner:
         return results
 
     def _build_llm_judge(self):
-        """G-Eval judge per EvalConfig: OpenRouter-compatible endpoint when
-        an API key is present (ref use_openrouter path), else skipped with a
-        warning — never a hard failure."""
+        """G-Eval judge resolution: an injected judge wins, then a local
+        Backend-protocol judge (EvalConfig.judge_backend — the offline path),
+        then an OpenRouter-compatible endpoint when an API key is present
+        (ref use_openrouter path); otherwise skipped with a warning — never
+        a hard failure."""
         import os
 
         from ..eval import LLMJudge
 
         cfg = self.config.evaluation
+        if self.llm_judge is not None:
+            return self.llm_judge
+        if cfg.judge_backend:
+            return LLMJudge(backend=self._judge_backend(cfg.judge_backend))
         api_key = os.environ.get("OPENROUTER_API_KEY") or os.environ.get(
             "OPENAI_API_KEY"
         )
@@ -421,6 +431,43 @@ class PipelineRunner:
             else "https://api.openai.com/v1"
         )
         return LLMJudge(api_base=base, api_key=api_key, model=cfg.llm_model)
+
+    def _judge_backend(self, spec: str) -> Backend:
+        """Resolve EvalConfig.judge_backend into a judge Backend. A bare
+        string can't carry model kwargs, so each form is explicit:
+        "fake" (CI), "ollama:<model>" (local server), "tpu:<registry-name>"
+        (on-device judge — RANDOM weights unless the registry model maps to
+        a loaded checkpoint elsewhere, so plumbing/containment runs only)."""
+        name, _, arg = spec.partition(":")
+        if name == "fake":
+            return get_backend("fake")
+        if name == "ollama":
+            if not arg:
+                raise ValueError(
+                    "judge_backend='ollama:<model>' needs the model tag"
+                )
+            return get_backend(
+                "ollama", model=arg, url=self.config.ollama_url
+            )
+        if name == "tpu":
+            from ..models import MODEL_REGISTRY
+
+            if arg not in MODEL_REGISTRY:
+                raise ValueError(
+                    "judge_backend='tpu:<model>' needs a registry model "
+                    f"name (have {sorted(MODEL_REGISTRY)}); a bare 'tpu' "
+                    "would silently judge with an unspecified model"
+                )
+            logger.warning(
+                "tpu judge %r runs RANDOM-INIT weights on this host — "
+                "scores will mostly fail to parse; use an HTTP judge or "
+                "inject PipelineRunner(llm_judge=...) for real judging",
+                arg,
+            )
+            return get_backend(
+                "tpu", model_config=MODEL_REGISTRY[arg](), max_new_tokens=64
+            )
+        raise ValueError(f"unknown judge_backend spec {spec!r}")
 
     # -- orchestration -----------------------------------------------------
 
