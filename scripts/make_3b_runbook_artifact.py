@@ -11,9 +11,12 @@ attached chip, without network access to the real weights:
 2. load it through the production converter onto the TPU, timing the load
    and recording HBM in use;
 3. logit-parity against HF transformers' LlamaForCausalLM running the SAME
-   checkpoint on CPU (the external oracle — the same role it plays in the
-   tiny-config tests, now at 3B scale): argmax agreement + max|Δ| under
-   bf16-vs-f32 tolerance;
+   checkpoint on CPU in float32 — and OUR side in float32 too, so the
+   comparison is falsifiable (VERDICT r3 weak #1: bf16 vs f32 on random
+   weights is the regime where argmax disagreement is maximal and least
+   informative). 128+64 positions at two sequence lengths, argmax agreement
+   + top-5 overlap, gated at >= 0.99 f32 agreement; the production bf16
+   load is then re-measured for context;
 4. run the int8-quantized engine on the converted weights and record decode
    throughput.
 
@@ -142,7 +145,7 @@ def main() -> int:
     ap.add_argument("--work", default="/tmp/vnsum_3b_runbook")
     ap.add_argument("--out", default="artifacts/runbook_3b.json")
     ap.add_argument("--batch-size", type=int, default=8)
-    ap.add_argument("--oracle-positions", type=int, default=12)
+    ap.add_argument("--oracle-positions", type=int, default=128)
     args = ap.parse_args()
 
     import numpy as np
@@ -184,12 +187,16 @@ def main() -> int:
     import torch
     import transformers
 
-    S = args.oracle_positions
+    S_FULL = args.oracle_positions          # 128 default
+    S_SHORT = max(S_FULL // 2, 1)           # second sequence length (64)
     rng = np.random.default_rng(0)
-    tokens = rng.integers(0, cfg0.vocab_size, (1, S), dtype=np.int64)
+    tokens = rng.integers(0, cfg0.vocab_size, (1, S_FULL), dtype=np.int64)
     # cached INSIDE the checkpoint dir so deleting/regenerating the
-    # checkpoint also invalidates the oracle computed from it
-    oracle_path = os.path.join(export_dir, "oracle_logits.npy")
+    # checkpoint also invalidates the oracle computed from it. A causal
+    # decoder's logits at positions < S_SHORT are identical in the S_FULL
+    # forward, so ONE oracle forward serves both lengths; our side runs
+    # separate S=64 and S=128 programs (different padding/bucket shapes).
+    oracle_path = os.path.join(export_dir, f"oracle_logits_{S_FULL}.npy")
     t0 = time.time()
     if os.path.exists(oracle_path):
         oracle = np.load(oracle_path)
@@ -219,6 +226,72 @@ def main() -> int:
         prefill_positions,
     )
 
+    def our_logits(cfg, params, S):
+        toks32 = tokens[:, :S].astype(np.int32)
+        pad = np.zeros((1,), np.int32)
+
+        @jax.jit
+        def prefill_logits(p, toks):
+            cache = init_kv_cache(cfg, 1, S)
+            out, _ = forward(
+                p, cfg, toks,
+                prefill_positions(jnp.asarray(pad), S), cache, 0,
+                prefill_attention_mask(jnp.asarray(pad), S, S),
+            )
+            return out
+
+        return np.asarray(prefill_logits(params, jnp.asarray(toks32)),
+                          np.float32)
+
+    def parity_metrics(ours, S):
+        ref = oracle[:, :S]
+        argmax_agree = float((ours.argmax(-1) == ref.argmax(-1)).mean())
+        k = 5
+        top_ours = np.argsort(-ours, axis=-1)[..., :k]
+        top_ref = np.argsort(-ref, axis=-1)[..., :k]
+        overlap = np.mean([
+            len(set(top_ours[0, p]) & set(top_ref[0, p])) / k
+            for p in range(S)
+        ])
+        return {
+            "positions": S,
+            "argmax_agreement": argmax_agree,
+            "top5_overlap": float(overlap),
+            "logit_max_abs_diff": float(np.max(np.abs(ours - ref))),
+        }
+
+    # float32 pass FIRST: same numerics as the oracle, so disagreement is a
+    # converter bug, not dtype noise — this is the gated check. It runs on
+    # the HOST CPU device: 12.86 GB of f32 weights leave a 16 GB chip no
+    # temp headroom (measured OOM), and converter correctness is
+    # device-independent — the bf16 pass below covers the chip itself.
+    t0 = time.time()
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        cfg, params32 = load_hf_checkpoint(export_dir, dtype=jnp.float32)
+        jax.block_until_ready(params32)
+        rec["steps"]["load_seconds_f32_cpu"] = round(time.time() - t0, 1)
+        f32_parities = [
+            parity_metrics(our_logits(cfg, params32, S), S)
+            for S in (S_SHORT, S_FULL)
+        ]
+    del params32
+    gc.collect()
+    rec["steps"]["parity_f32"] = {
+        "oracle": "transformers.LlamaForCausalLM (CPU, float32)",
+        "engine_dtype": "float32",
+        "engine_device": "cpu (f32 3B + temps exceed one 16 GB chip)",
+        "per_length": f32_parities,
+    }
+    worst = min(p["argmax_agreement"] for p in f32_parities)
+    print(f"f32 parity: {f32_parities}", file=sys.stderr)
+    if worst < 0.99:
+        raise RuntimeError(
+            f"3B converter f32 parity failed: {rec['steps']['parity_f32']}"
+        )
+
+    # production bf16 load: context numbers (argmax flips here are dtype
+    # noise quantified against the gated f32 baseline above)
     t0 = time.time()
     cfg, params = load_hf_checkpoint(export_dir, dtype=jnp.bfloat16)
     jax.block_until_ready(params)
@@ -226,41 +299,20 @@ def main() -> int:
     rec["steps"]["hbm_after_load"] = hbm_stats()
     print(f"load_hf_checkpoint: {rec['steps']['load_seconds']}s; "
           f"HBM {rec['steps']['hbm_after_load']}", file=sys.stderr)
-
-    toks32 = tokens.astype(np.int32)
-    pad = np.zeros((1,), np.int32)
-
-    @jax.jit
-    def prefill_logits(p, toks):
-        cache = init_kv_cache(cfg, 1, S)
-        out, _ = forward(
-            p, cfg, toks,
-            prefill_positions(jnp.asarray(pad), S), cache, 0,
-            prefill_attention_mask(jnp.asarray(pad), S, S),
-        )
-        return out
-
-    ours = np.asarray(prefill_logits(params, jnp.asarray(toks32)), np.float32)
-
-    argmax_agree = float(
-        (ours.argmax(-1) == oracle.argmax(-1)).mean()
-    )
-    max_abs = float(np.max(np.abs(ours - oracle)))
-    # bf16 TPU vs f32 CPU at 28 layers: per-position logit magnitudes are
-    # O(1) at random init; allow bf16 accumulation noise
-    rec["steps"]["parity"] = {
-        "oracle": "transformers.LlamaForCausalLM (CPU, float32)",
-        "positions": S,
-        "argmax_agreement": argmax_agree,
-        "logit_max_abs_diff": max_abs,
+    rec["steps"]["parity_bf16_context"] = {
+        "engine_dtype": "bfloat16",
+        "per_length": [
+            parity_metrics(our_logits(cfg, params, S), S)
+            for S in (S_SHORT, S_FULL)
+        ],
     }
-    print(f"parity vs HF oracle: argmax agreement {argmax_agree:.3f}, "
-          f"max|Δ|={max_abs:.4f}", file=sys.stderr)
-    if argmax_agree < 0.9:
-        raise RuntimeError(f"3B converter parity failed: {rec['steps']['parity']}")
+    print(f"bf16 context: {rec['steps']['parity_bf16_context']}",
+          file=sys.stderr)
 
     # ---- int8 engine throughput on the converted weights ----
     from vnsum_tpu.backend.engine import TpuBackend
+
+    from vnsum_tpu.core.config import GenerationConfig
 
     be = TpuBackend(
         model_config=cfg, tokenizer="byte", params=params,
@@ -271,16 +323,25 @@ def main() -> int:
     prompt = "Tóm tắt văn bản sau bằng tiếng Việt: " + (
         "Quốc hội thông qua nghị quyết về phát triển kinh tế. " * 18
     )
-    be.generate([prompt] * args.batch_size)  # compile + warmup
+    # SAMPLED decode: greedy on random weights now stops at the (correctly
+    # sampleable) native EOS within a token or two, which would measure
+    # prefill only; temperature-1.0 rows run most of the budget with
+    # scattered EOS stops — the real decode workload shape
+    gen = GenerationConfig(temperature=1.0, seed=7)
+    be.generate([prompt] * args.batch_size, config=gen)  # compile + warmup
+    g0 = be.stats.generated_tokens
     t0 = time.time()
     outs = be.generate(
-        [prompt + f" ({i})" for i in range(args.batch_size)]
+        [prompt + f" ({i})" for i in range(args.batch_size)], config=gen
     )
     dt = time.time() - t0
     rec["steps"]["engine"] = {
         "batch_size": args.batch_size,
         "quantize": "int8 weight-only",
+        "decode": "sampled T=1.0 (see comment: greedy random-init stops "
+                  "at EOS instantly)",
         "generate_seconds": round(dt, 2),
+        "generated_tokens": be.stats.generated_tokens - g0,
         "tokens_per_second_overall": round(be.stats.tokens_per_second, 1),
         "hbm_after_engine": hbm_stats(),
         "outputs_nonempty": sum(bool(o) for o in outs),
@@ -302,7 +363,7 @@ def main() -> int:
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(rec, indent=2))
     print(json.dumps({"ok": True, "artifact": str(out),
-                      "argmax_agreement": argmax_agree,
+                      "f32_argmax_agreement_min": worst,
                       "load_seconds": rec["steps"]["load_seconds"]}))
     return 0
 

@@ -209,3 +209,40 @@ def test_engine_decode_kernel_path_matches_dense_cpu():
         stacked_attention_fn=stacked,
     )
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got), rtol=2e-4, atol=2e-4)
+
+
+def test_decode_return_partials_normalize_to_direct():
+    """return_partials exposes the unnormalized (o, m, l) state; o/l must
+    equal the kernel's own normalized output (the long-context LSE merge
+    depends on this contract)."""
+    L, B, KV, C, H, hd = 2, 2, 2, 64, 4, 128
+    q, cache = make_case(L, B, KV, C, H, hd, seed=17)
+    pad = jnp.asarray([0, 5], jnp.int32)
+    fill = 40
+    direct = flash_decode_attention(
+        q, cache, 1, pad, fill, H // KV, block_k=16, interpret=True
+    )
+    o, m, l = flash_decode_attention(
+        q, cache, 1, pad, fill, H // KV, block_k=16, interpret=True,
+        return_partials=True,
+    )
+    assert o.shape == (B, H, hd) and m.shape == l.shape == (B, H)
+    normalized = o / np.maximum(np.asarray(l), 1e-30)[..., None]
+    np.testing.assert_allclose(
+        normalized, np.asarray(direct)[:, 0], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_decode_partials_fully_masked_rows_are_inert():
+    """A row whose pad covers the whole cache (an empty shard in the
+    long-context merge) must come back with l=0 so the cross-shard merge
+    ignores it."""
+    L, B, KV, C, H, hd = 1, 2, 1, 32, 2, 128
+    q, cache = make_case(L, B, KV, C, H, hd, seed=3)
+    pad = jnp.asarray([0, 32], jnp.int32)  # row 1: everything padded out
+    o, m, l = flash_decode_attention(
+        q, cache, 0, pad, 31, H // KV, block_k=8, interpret=True,
+        return_partials=True,
+    )
+    assert np.asarray(l)[1].max() == 0.0
+    assert np.asarray(l)[0].min() > 0.0

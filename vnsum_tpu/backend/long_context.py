@@ -82,10 +82,11 @@ def long_prefill(
 ):
     """One ring-attention forward over the full (sharded) prompt.
 
-    Returns (last_logits [B, V] f32, prefill_cache {"k","v": [L, B, S, KV,
-    hd]}) with the cache's S dim sharded over the seq axis. Remat is on by
-    default: prefill is one giant forward, and recomputing block activations
-    is far cheaper than holding S-long intermediates for XLA's scheduler."""
+    Returns (last_logits [B, V] f32, prefill_cache {"k","v": [L, B, KV, S,
+    hd]}) — the ENGINE-NATIVE stacked layout, S sharded over the seq axis.
+    Remat is on by default: prefill is one giant forward, and recomputing
+    block activations is far cheaper than holding S-long intermediates for
+    XLA's scheduler."""
     B, S = tokens.shape
     x = _embed_lookup(params["embed"], tokens, cfg.dtype)
     positions = prefill_positions(pad_lens, S)
@@ -94,16 +95,20 @@ def long_prefill(
 
     def block(x, lp):
         # ONE copy of the decoder math (models.llama.cache_free_block, the
-        # same block forward_train scans) — here the k/v become the cache
-        return cache_free_block(x, lp, cos, sin, cfg, attention)
+        # same block forward_train scans) — here the k/v become the cache,
+        # transposed PER LAYER to the engine-native [B, KV, S, hd] order
+        # (ops/decode_attention's axis order) so the scan stacks the final
+        # layout directly — a post-scan whole-cache transpose would hold
+        # two full copies at the exact moment of peak HBM use
+        x, (k, v) = cache_free_block(x, lp, cos, sin, cfg, attention)
+        return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
 
     if remat:
         block = jax.checkpoint(block)
 
     x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
-    # pin the stacked cache's layout: [L, B, S, KV, hd], S over seq
     cache_spec = NamedSharding(
-        mesh, P(None, AXES.data, AXES.seq, AXES.model, None)
+        mesh, P(None, AXES.data, AXES.model, AXES.seq, None)
     )
     ks = jax.lax.with_sharding_constraint(ks, cache_spec)
     vs = jax.lax.with_sharding_constraint(vs, cache_spec)
@@ -114,8 +119,8 @@ def long_prefill(
 
 
 def quantize_prefill_cache(cache: dict) -> dict:
-    """[L, B, S, KV, hd] bf16 cache -> int8 values + per-(layer, token,
-    head) f32 scales. Decode streams every shard's cache each step, so this
+    """[L, B, KV, S, hd] bf16 cache -> int8 values + per-(layer, head,
+    token) f32 scales. Decode streams every shard's cache each step, so this
     halves long-context decode HBM traffic (the engine's per-vector scheme,
     models.llama._quantize_kv — axis-agnostic over leading dims)."""
     from ..models.llama import _quantize_kv
@@ -134,32 +139,32 @@ def _prefill_partial_local(
 ):
     """Per-device online-softmax partial over the local prefill-cache shard,
     merged across the seq axis inside (pmax/psum). q [B, H, hd];
-    k_loc/v_loc [B, S_loc, KV, hd] (int8 when k_scale/v_scale [B, S_loc, KV]
-    are given). Returns (o [B, H, hd] f32, m, l [B, H])."""
+    k_loc/v_loc [B, KV, S_loc, hd] (int8 when k_scale/v_scale [B, KV, S_loc]
+    are given). Returns (o [B, H, hd] f32, m, l [B, H]). Dense fallback for
+    head dims the Pallas kernel can't take (see _kernel_partial_local)."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, hd = q.shape
-    S_loc = k_loc.shape[1]
-    KV = k_loc.shape[2]
+    KV = k_loc.shape[1]
+    S_loc = k_loc.shape[2]
     G = q_per_kv
 
     qg = q.reshape(B, KV, G, hd)
     if k_scale is not None:
         # int8 cache stays int8 into the MXU (the dtype convert fuses into
-        # the tile load); the per-(token, head) scale is constant over the
+        # the tile load); the per-(head, token) scale is constant over the
         # contracted hd dim, so it factors out of the dot EXACTLY and
-        # multiplies the scores instead. The f32-dequantized shard copy —
-        # 4x the int8 read, per layer per step — never materializes, which
-        # is most of what a shard-local Pallas kernel would buy here.
+        # multiplies the scores instead — the f32-dequantized shard copy
+        # never materializes.
         scores = (
-            jnp.einsum("bkgh,bskh->bkgs", qg, k_loc.astype(qg.dtype),
+            jnp.einsum("bkgh,bksh->bkgs", qg, k_loc.astype(qg.dtype),
                        preferred_element_type=jnp.float32)
-            * k_scale.transpose(0, 2, 1)[:, :, None, :]
+            * k_scale[:, :, None, :]
             / jnp.sqrt(jnp.float32(hd))
         )
     else:
         scores = (
-            jnp.einsum("bkgh,bskh->bkgs", qg, k_loc,
+            jnp.einsum("bkgh,bksh->bkgs", qg, k_loc,
                        preferred_element_type=jnp.float32)
             / jnp.sqrt(jnp.float32(hd))
         )
@@ -173,10 +178,10 @@ def _prefill_partial_local(
     if v_scale is not None:
         # same trick on the value side: scale the probabilities along s
         # (constant over hd), keep v int8 in the matmul
-        pv = p * v_scale.transpose(0, 2, 1)[:, :, None, :]
-        o = jnp.einsum("bkgs,bskh->bkgh", pv, v_loc.astype(jnp.float32))
+        pv = p * v_scale[:, :, None, :]
+        o = jnp.einsum("bkgs,bksh->bkgh", pv, v_loc.astype(jnp.float32))
     else:
-        o = jnp.einsum("bkgs,bskh->bkgh", p, v_loc.astype(jnp.float32))
+        o = jnp.einsum("bkgs,bksh->bkgh", p, v_loc.astype(jnp.float32))
 
     m_g = jax.lax.pmax(m, axis_name)
     corr = jnp.exp(m - m_g)
@@ -189,34 +194,110 @@ def _prefill_partial_local(
     )
 
 
+def _kernel_partial_local(
+    q, k_all, v_all, pad_lens, layer_idx, k_scale=None, v_scale=None, *,
+    q_per_kv, axis_name, interpret,
+):
+    """Kernelized shard-local partial (VERDICT r3 #5): the stacked-cache
+    decode kernel runs on each device's cache shard — layer selection via
+    scalar prefetch (no per-layer extraction copy), int8 K/V streamed with
+    in-kernel dequant — and its unnormalized (o, m, l) state LSE-merges
+    across the seq axis exactly like the dense partial's.
+
+    q [B, H, hd]; k_all/v_all the WHOLE local stacked shard
+    [L, B, KV, S_loc, hd] (+ scales [L, B, KV, S_loc])."""
+    idx = jax.lax.axis_index(axis_name)
+    S_loc = k_all.shape[3]
+    # left-pad boundary in this shard's local coordinates: rows whose global
+    # pad falls past the shard mask out entirely (the kernel then emits
+    # m=-inf, l=0 — inert in the merge)
+    pads_local = jnp.clip(pad_lens - idx * S_loc, 0, S_loc)
+    cache = {"k": k_all, "v": v_all}
+    if k_scale is not None:
+        cache.update(ks=k_scale, vs=v_scale)
+    from ..ops.decode_attention import flash_decode_attention
+
+    o, m, l = flash_decode_attention(
+        q[:, None], cache, layer_idx, pads_local, S_loc - 1, q_per_kv,
+        return_partials=True, interpret=interpret,
+    )
+    m_g = jax.lax.pmax(m, axis_name)
+    corr = jnp.exp(m - m_g)
+    l_g = jax.lax.psum(l * corr, axis_name)
+    o_g = jax.lax.psum(o * corr[..., None], axis_name)
+    return o_g, m_g, l_g
+
+
 def make_long_decode_attention(
-    mesh: Mesh, prefill_cache: dict, pad_lens: jax.Array, q_per_kv: int
+    mesh: Mesh, prefill_cache: dict, pad_lens: jax.Array, q_per_kv: int,
+    *, decode_kernel: str | bool = "auto", interpret: bool = False,
 ):
     """Build the merged attention for models.llama.forward's
     ``stacked_attention_fn`` seam: the returned ``attention(q, cache,
     layer_idx, t)`` attends over BOTH the frozen seq-sharded prefill cache
     (closure) and the small replicated decode cache, valid slots 0..t; the
-    decode loop binds ``t`` per step via a lambda."""
+    decode loop binds ``t`` per step via a lambda.
+
+    ``decode_kernel`` "auto" runs the Pallas stacked-cache kernel on each
+    shard when the head dim is lane-aligned (or under interpret), else the
+    dense einsum partial — the kernel consumes the whole stacked shard with
+    the layer chosen by scalar prefetch, so the per-step per-layer
+    extraction copy of the shard never materializes."""
     quantized = "ks" in prefill_cache
-    kv_spec = P(AXES.data, AXES.seq, AXES.model, None)
-    scale_spec = P(AXES.data, AXES.seq, AXES.model)
-    in_specs = [
-        P(AXES.data, AXES.model, None), kv_spec, kv_spec, P(AXES.data),
-    ]
-    if quantized:
-        in_specs += [scale_spec, scale_spec]
-    partial_fn = shard_map(
-        partial(
-            _prefill_partial_local, q_per_kv=q_per_kv, axis_name=AXES.seq
-        ),
-        mesh=mesh,
-        in_specs=tuple(in_specs),
-        out_specs=(
-            P(AXES.data, AXES.model, None),
-            P(AXES.data, AXES.model),
-            P(AXES.data, AXES.model),
-        ),
+    hd = prefill_cache["k"].shape[-1]
+    if decode_kernel == "auto":
+        # real kernels need Mosaic on the MESH's devices (not the process
+        # default backend — on this host the TPU plugin is default even
+        # when the mesh is host-CPU) AND a lane-aligned head dim
+        # (supports_decode — ONE copy of that rule); interpret mode
+        # simulates them anywhere
+        from ..ops.decode_attention import supports_decode
+
+        S_total = prefill_cache["k"].shape[3]
+        mesh_platform = next(iter(mesh.devices.flat)).platform
+        decode_kernel = interpret or (
+            mesh_platform == "tpu" and supports_decode(S_total, hd)
+        )
+    out_specs = (
+        P(AXES.data, AXES.model, None),
+        P(AXES.data, AXES.model),
+        P(AXES.data, AXES.model),
     )
+    if decode_kernel:
+        kv_spec = P(None, AXES.data, AXES.model, AXES.seq, None)
+        scale_spec = P(None, AXES.data, AXES.model, AXES.seq)
+        in_specs = [
+            P(AXES.data, AXES.model, None), kv_spec, kv_spec, P(AXES.data),
+            P(),
+        ]
+        if quantized:
+            in_specs += [scale_spec, scale_spec]
+        partial_fn = shard_map(
+            partial(
+                _kernel_partial_local, q_per_kv=q_per_kv,
+                axis_name=AXES.seq, interpret=interpret,
+            ),
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    else:
+        kv_spec = P(AXES.data, AXES.model, AXES.seq, None)
+        scale_spec = P(AXES.data, AXES.model, AXES.seq)
+        in_specs = [
+            P(AXES.data, AXES.model, None), kv_spec, kv_spec, P(AXES.data),
+        ]
+        if quantized:
+            in_specs += [scale_spec, scale_spec]
+        partial_fn = shard_map(
+            partial(
+                _prefill_partial_local, q_per_kv=q_per_kv, axis_name=AXES.seq
+            ),
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=out_specs,
+        )
 
     def attention(q, cache, layer_idx, t):
         """q [B, 1, H, hd]; cache = small decode cache [L, B, KV, C, hd];
@@ -224,14 +305,23 @@ def make_long_decode_attention(
         B, _, H, hd = q.shape
         q1 = q[:, 0]
 
-        def layer(name):
-            return jax.lax.dynamic_index_in_dim(
-                prefill_cache[name], layer_idx, 0, keepdims=False
-            )
+        if decode_kernel:
+            args = [
+                q1, prefill_cache["k"], prefill_cache["v"], pad_lens,
+                jnp.asarray(layer_idx, jnp.int32),
+            ]
+            if quantized:
+                args += [prefill_cache["ks"], prefill_cache["vs"]]
+        else:
 
-        args = [q1, layer("k"), layer("v"), pad_lens]
-        if quantized:
-            args += [layer("ks"), layer("vs")]
+            def layer(name):
+                return jax.lax.dynamic_index_in_dim(
+                    prefill_cache[name], layer_idx, 0, keepdims=False
+                )
+
+            args = [q1, layer("k"), layer("v"), pad_lens]
+            if quantized:
+                args += [layer("ks"), layer("vs")]
         o1, m1, l1 = partial_fn(*args)
 
         # decode-cache partial (replicated math; C = max_new is small)
@@ -291,6 +381,8 @@ def generate_long_tokens(
     quantize_kv: bool = False,
     vocab_limit: int = 0,
     vocab_allowed=None,
+    decode_kernel: str | bool = "auto",
+    interpret: bool = False,
 ) -> jax.Array:
     """Traceable end-to-end long-context generation; returns [B, max_new].
 
@@ -323,7 +415,8 @@ def generate_long_tokens(
     done0 = pad_lens == S  # all-pad filler rows start done
 
     attention = make_long_decode_attention(
-        mesh, prefill_cache, pad_lens, cfg.q_per_kv
+        mesh, prefill_cache, pad_lens, cfg.q_per_kv,
+        decode_kernel=decode_kernel, interpret=interpret,
     )
     decode_cache0 = init_kv_cache(cfg, B, max_new)
     out0 = jnp.full((B, max_new), pad_id, dtype=jnp.int32)
@@ -381,6 +474,8 @@ class LongContextBackend:
         seed: int = 0,
         quantize: bool = False,
         quantize_kv: bool = False,
+        decode_kernel: str | bool = "auto",
+        interpret: bool = False,
     ) -> None:
         from ..models.llama import init_params, llama32_3b
 
@@ -433,6 +528,8 @@ class LongContextBackend:
         self._dispatch = 0
         self._fns: dict = {}
         self.quantize_kv = bool(quantize_kv)
+        self.decode_kernel = decode_kernel
+        self.interpret = bool(interpret)
         if params is None:
             from ..models import jitted_init
 
@@ -543,6 +640,8 @@ class LongContextBackend:
                     quantize_kv=self.quantize_kv,
                     vocab_limit=vocab_limit,
                     vocab_allowed=vocab_allowed,
+                    decode_kernel=self.decode_kernel,
+                    interpret=self.interpret,
                 )
 
             self._fns[key] = jax.jit(

@@ -59,12 +59,25 @@ def _kernel(
     n_kv: int,
     scale: float,
     quantized: bool,
+    return_partials: bool = False,
 ):
-    if quantized:
+    if return_partials:
+        # outputs are the UNNORMALIZED online-softmax state (acc, m, l) —
+        # the shard-local form the long-context path LSE-merges across the
+        # seq axis (backend.long_context make_long_decode_attention)
+        if quantized:
+            (q_ref, pads_ref, k_ref, v_ref, ks_ref, vs_ref,
+             o_ref, mo_ref, lo_ref, acc_ref, m_ref, l_ref) = refs
+        else:
+            (q_ref, pads_ref, k_ref, v_ref,
+             o_ref, mo_ref, lo_ref, acc_ref, m_ref, l_ref) = refs
+            ks_ref = vs_ref = None
+    elif quantized:
         q_ref, pads_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        mo_ref = lo_ref = None
     else:
         q_ref, pads_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
-        ks_ref = vs_ref = None
+        ks_ref = vs_ref = mo_ref = lo_ref = None
     # q_ref/o_ref [1, BB*KV, G, hd] (host pre-merges the batch/head dims —
     # Mosaic supports MERGING leading dims in-kernel but not splitting them,
     # and tpu.matmul takes a single batch dim); pads_ref [1, BB*KV, 1, BK]
@@ -138,8 +151,13 @@ def _kernel(
 
     @pl.when(j == nj - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:, :, :1], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        if return_partials:
+            o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+            mo_ref[0] = m_ref[...]
+            lo_ref[0] = l_ref[...]
+        else:
+            l = jnp.maximum(l_ref[:, :, :1], 1e-30)
+            o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
 def _pick_block_b(batch: int) -> int:
@@ -155,7 +173,8 @@ def supports_decode(cache_len: int, head_dim: int) -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("q_per_kv", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("q_per_kv", "block_k", "interpret", "return_partials"),
 )
 def flash_decode_attention(
     q: jax.Array,          # [B, 1, H, hd]
@@ -168,12 +187,18 @@ def flash_decode_attention(
     *,
     block_k: int = 128,
     interpret: bool = False,
+    return_partials: bool = False,
 ) -> jax.Array:
     """Semantics match _attention(q, dequantized cache[layer],
     mask=pad<=j<=fill); returns [B, 1, H, hd]. ``window`` > 0 restricts to
     the last ``window`` slots (Gemma sliding layers): below-window blocks
     are compute-skipped and DMA-elided like past-fill blocks, so a sliding
-    layer's step reads only ~window worth of cache however long the fill."""
+    layer's step reads only ~window worth of cache however long the fill.
+
+    ``return_partials=True`` returns the unnormalized online-softmax state
+    ``(o [B, H, hd] f32, m [B, H] f32, l [B, H] f32)`` instead — the
+    shard-local partial the long-context decode LSE-merges across the seq
+    axis (same contract as backend.long_context._prefill_partial_local)."""
     k_all, v_all = cache["k"], cache["v"]
     quantized = "ks" in cache
     B, S, H, hd = q.shape
@@ -230,25 +255,41 @@ def flash_decode_attention(
 
     kernel = functools.partial(
         _kernel, block_b=bb, block_k=bk, n_kv=KV, scale=1.0 / (hd ** 0.5),
-        quantized=quantized,
+        quantized=quantized, return_partials=return_partials,
     )
+    out_block = lambda shape: pl.BlockSpec(  # noqa: E731
+        (1, *shape), lambda b, j, lidx, fill, win: (b,) + (0,) * len(shape)
+    )
+    if return_partials:
+        out_specs = (
+            out_block((bb * KV, q_per_kv, hd)),
+            out_block((bb * KV, q_per_kv, _LANES)),
+            out_block((bb * KV, q_per_kv, _LANES)),
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((B // bb, bb * KV, q_per_kv, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B // bb, bb * KV, q_per_kv, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B // bb, bb * KV, q_per_kv, _LANES), jnp.float32),
+        )
+    else:
+        out_specs = out_block((bb * KV, q_per_kv, hd))
+        out_shape = jax.ShapeDtypeStruct(
+            (B // bb, bb * KV, q_per_kv, hd), q.dtype
+        )
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(
-                (1, bb * KV, q_per_kv, hd),
-                lambda b, j, lidx, fill, win: (b, 0, 0, 0),
-            ),
+            out_specs=out_specs,
             scratch_shapes=[
                 pltpu.VMEM((bb * KV, q_per_kv, hd), jnp.float32),
                 pltpu.VMEM((bb * KV, q_per_kv, _LANES), jnp.float32),
                 pltpu.VMEM((bb * KV, q_per_kv, _LANES), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B // bb, bb * KV, q_per_kv, hd), q.dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(
         jnp.asarray(layer_idx, jnp.int32).reshape(1),
@@ -256,4 +297,11 @@ def flash_decode_attention(
         jnp.asarray(0 if window is None else window, jnp.int32).reshape(1),
         *operands,
     )
+    if return_partials:
+        o, m, l = out
+        return (
+            o.reshape(B, H, hd),
+            m[..., 0].reshape(B, H),
+            l[..., 0].reshape(B, H),
+        )
     return out.reshape(B, 1, H, hd)

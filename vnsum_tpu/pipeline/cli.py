@@ -95,6 +95,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="truncated approach: context budget in tokens (ref default "
         "16384); with --long-context this may exceed the one-chip limit",
     )
+    p.add_argument(
+        "--include-llm-eval", action="store_true",
+        help="run the G-Eval correctness/coherence column (reference "
+        "include_llm_eval); needs OPENROUTER_API_KEY/OPENAI_API_KEY or "
+        "--judge-backend",
+    )
+    p.add_argument(
+        "--judge-backend", default=None,
+        help="offline G-Eval judge over the Backend protocol: 'fake' (CI), "
+        "'ollama:<model>', or 'tpu:<registry-name>'; implies "
+        "--include-llm-eval",
+    )
     return p
 
 
@@ -146,6 +158,11 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
     )
     if args.embedding_dir:
         cfg.evaluation.embedding_dir = args.embedding_dir
+    if args.include_llm_eval:
+        cfg.evaluation.include_llm_eval = True
+    if args.judge_backend:
+        cfg.evaluation.include_llm_eval = True
+        cfg.evaluation.judge_backend = args.judge_backend
     return cfg
 
 
