@@ -1,0 +1,99 @@
+"""A/B: W8A8 prefill (s8xs8 MXU dots) vs weight-only int8, on the chip.
+
+Measured motivation (PERF.md finding 14): the e2e is prefill-bound — 67% of
+summarize at 0.53 bf16-MFU — and the chained-matmul microbench puts the
+s8xs8 MXU path at 1.6x the bf16 rate (132.7 vs 83.1 TFLOP/s at 4096^3).
+This script runs the REAL 3B prefill shape (B=8, S=8192, instrumented
+split programs) both ways and records the prefill seconds; decode is
+untouched by design (single-token forwards keep the exact path).
+
+Writes artifacts/w8a8_ab.json.
+"""
+from __future__ import annotations
+
+import gc
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+_FILLER = "Quốc hội thông qua nghị quyết phát triển kinh tế xã hội. "
+
+
+def run_arm(quantize_act: bool, params) -> dict:
+    import numpy as np
+
+    from vnsum_tpu.backend.engine import EngineStats, TpuBackend
+    from vnsum_tpu.core.config import GenerationConfig
+    from vnsum_tpu.models import llama32_3b
+
+    be = TpuBackend(
+        model_config=llama32_3b(max_seq_len=8448),
+        tokenizer="byte",
+        params=params,
+        batch_size=8,
+        max_new_tokens=128,
+        quantize=True,
+        quantize_act=quantize_act,
+        instrument=True,
+    )
+    gen = GenerationConfig(temperature=1.0, seed=11)
+    body = _FILLER * (8100 // len(_FILLER.encode()) + 1)
+    prompts = [f"tài liệu {i}: {body}"[:8100] for i in range(8)]
+    be.generate(prompts, config=gen)  # compile + warm
+    be.stats = EngineStats()
+    rounds = 3
+    t0 = time.time()
+    for r in range(rounds):
+        be.generate([f"vòng {r} " + p for p in prompts], config=gen)
+    wall = time.time() - t0
+    st = be.stats
+    arm = {
+        "quantize_act": quantize_act,
+        # snapshot: the sanity generate below appends a fresh-bucket (and
+        # compile-polluted) dispatch that must not land in the record
+        "dispatches": list(st.dispatches),
+        "prefill_s": round(st.phase_seconds.get("prefill", 0.0), 2),
+        "decode_s": round(st.phase_seconds.get("decode", 0.0), 2),
+        "wall_s": round(wall, 1),
+        "prefill_tokens_per_sec": round(
+            sum(d["B"] * d["S"] for d in st.dispatches)
+            / max(st.phase_seconds.get("prefill", 0.0), 1e-9), 1,
+        ),
+    }
+    # first-token sanity across a couple of rows: outputs remain text
+    outs = be.generate(prompts[:2], config=gen)
+    arm["outputs_nonempty"] = sum(bool(o) for o in outs)
+    del be
+    gc.collect()
+    return arm
+
+
+def main() -> int:
+    from vnsum_tpu.core.jax_cache import enable_compilation_cache
+    from vnsum_tpu.models import jitted_init, llama32_3b
+    from vnsum_tpu.models.llama import init_params
+
+    enable_compilation_cache()
+    params = jitted_init(init_params, llama32_3b(max_seq_len=8448), 0)
+
+    rec: dict = {"shape": "B=8, S=8192 bucket, 128 sampled new tokens, "
+                          "llama3.2-3b int8 weights"}
+    for qa in (False, True):
+        rec["w8a8" if qa else "weight_only"] = run_arm(qa, params)
+        print(rec["w8a8" if qa else "weight_only"], file=sys.stderr)
+    rec["prefill_speedup"] = round(
+        rec["weight_only"]["prefill_s"] / max(rec["w8a8"]["prefill_s"], 1e-9),
+        3,
+    )
+    out = REPO / "artifacts" / "w8a8_ab.json"
+    out.write_text(json.dumps(rec, indent=2))
+    print(json.dumps({"ok": True, "prefill_speedup": rec["prefill_speedup"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

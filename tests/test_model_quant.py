@@ -145,3 +145,130 @@ def test_init_params_quantized_runs_engine():
     )
     outs = be.generate(["văn bản", "hai"])
     assert len(outs) == 2 and all(isinstance(o, str) for o in outs)
+
+
+def test_w8a8_proj_exact_on_rounded_activations():
+    """_proj(act_quant=True) must equal the EXACT computation over the
+    int8-rounded activations and dequantized weights — the only loss is the
+    activation rounding itself. Checked for all four einsum shapes the
+    decoder uses."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vnsum_tpu.models.llama import _proj
+    from vnsum_tpu.models.quant import _quantize
+
+    rng = jax.random.PRNGKey(0)
+    B, S, D, H, hd, I = 2, 4, 32, 4, 8, 48
+    cases = [
+        ("bsd,dhk->bshk", (B, S, D), (D, H, hd), (0,)),
+        ("bshk,hkd->bsd", (B, S, H, hd), (H, hd, D), (0, 1)),
+        ("bsd,di->bsi", (B, S, D), (D, I), (0,)),
+        ("bsi,id->bsd", (B, S, I), (I, D), (0,)),
+    ]
+    for sub, xs, ws, contract in cases:
+        kx, kw, rng = jax.random.split(rng, 3)
+        x = jax.random.normal(kx, xs, jnp.float32)
+        w = jax.random.normal(kw, ws, jnp.float32)
+        wq = _quantize(w, contract)
+        got = np.asarray(_proj(sub, x, wq, act_quant=True))
+
+        # reference: round x per token over its contracted trailing dims,
+        # then the exact f32 einsum against the dequantized weight
+        axes = tuple(range(len(xs) - len(contract), len(xs)))
+        amax = np.max(np.abs(np.asarray(x)), axis=axes, keepdims=True)
+        s = np.maximum(amax, 1e-8) / 127.0
+        x_r = np.clip(np.round(np.asarray(x) / s), -127, 127) * s
+        sdeq = np.asarray(wq["s"])
+        for a in sorted(contract):
+            sdeq = np.expand_dims(sdeq, a)
+        w_deq = np.asarray(wq["q"], np.float32) * sdeq
+        want = np.einsum(sub, x_r, w_deq)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_w8a8_engine_runs_and_rejects_without_int8_weights():
+    import pytest
+
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.models import tiny_llama
+
+    cfg = tiny_llama(max_seq_len=128)
+    kw = dict(model_config=cfg, batch_size=2, max_new_tokens=8, seed=0)
+    with pytest.raises(ValueError, match="quantize_act"):
+        TpuBackend(quantize_act=True, **kw)
+    w8a8 = TpuBackend(quantize=True, quantize_act=True, **kw)
+    outs = w8a8.generate(["một văn bản dài hơn", "hai"])
+    assert len(outs) == 2 and all(isinstance(o, str) for o in outs)
+    assert w8a8.cfg.w8a8_prefill
+
+
+def test_w8a8_single_token_forward_bit_identical():
+    """The S>1 gate's precise claim, tested at the forward level: a
+    SINGLE-token forward (what every decode step is) must be bit-identical
+    with and without w8a8_prefill — and a multi-token forward must differ
+    (the flag actually does something)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vnsum_tpu.models import init_kv_cache, tiny_llama
+    from vnsum_tpu.models.llama import (
+        decode_attention_mask,
+        forward,
+        init_params,
+        prefill_attention_mask,
+        prefill_positions,
+    )
+    from vnsum_tpu.models.quant import quantize_params
+
+    cfg_a = tiny_llama(max_seq_len=128)
+    cfg_b = dataclasses.replace(cfg_a, w8a8_prefill=True)
+    params = quantize_params(init_params(jax.random.key(0), cfg_a))
+    B, C = 2, 16
+    pad = jnp.zeros((B,), jnp.int32)
+
+    # single token at decode position: identical graphs -> identical bits
+    tok1 = jnp.asarray([[5], [9]], jnp.int32)
+    cache = init_kv_cache(cfg_a, B, C)
+    mask1 = decode_attention_mask(pad, 0, C)
+    out_a, _ = forward(params, cfg_a, tok1, pad[:, None], cache, 0, mask1)
+    out_b, _ = forward(params, cfg_b, tok1, pad[:, None], cache, 0, mask1)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+    # multi-token prefill: the act-quant rounding must show up
+    S = 8
+    toks = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1)) + 3
+    cache = init_kv_cache(cfg_a, B, C)
+    maskS = prefill_attention_mask(pad, S, C)
+    pos = prefill_positions(pad, S)
+    pre_a, _ = forward(params, cfg_a, toks, pos, cache, 0, maskS)
+    pre_b, _ = forward(params, cfg_b, toks, pos, cache, 0, maskS)
+    assert not np.array_equal(np.asarray(pre_a), np.asarray(pre_b))
+
+
+def test_w8a8_mesh_sharded_matches_single_device():
+    """W8A8 prefill under a (data, model) mesh: the s8xs8 einsums partition
+    like any dot, and sharded outputs must equal unsharded exactly (same
+    rounding both sides)."""
+    import numpy as np
+
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.models import tiny_llama
+    from vnsum_tpu.parallel import make_mesh
+
+    cfg = tiny_llama(max_seq_len=128)
+    kw = dict(
+        model_config=cfg, batch_size=4, max_new_tokens=6, seed=3,
+        quantize=True, quantize_act=True,
+    )
+    plain = TpuBackend(**kw)
+    mesh = make_mesh({"data": 2, "model": 2, "seq": 1}, platform="cpu")
+    sharded = TpuBackend(mesh=mesh, **kw)
+    prompts = ["văn bản một", "văn bản thứ hai dài hơn", "ba", "bốn bốn"]
+    np.testing.assert_array_equal(
+        plain.generate(prompts), sharded.generate(prompts)
+    )

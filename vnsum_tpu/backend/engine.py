@@ -109,6 +109,7 @@ class TpuBackend:
         seed: int = 0,
         flash: str | bool = "auto",
         quantize: bool = False,
+        quantize_act: bool = False,
         quantize_kv: str | bool = "auto",
         continuous: str | bool = "auto",
         segment_tokens: int = 128,
@@ -120,6 +121,18 @@ class TpuBackend:
 
         enable_compilation_cache()  # per-bucket programs amortize on disk
         self.cfg = model_config or llama32_3b()
+        if quantize_act:
+            # W8A8 prefill (models.llama._proj): double-rate s8xs8 MXU
+            # dots on multi-token forwards. LOSSY (per-token activation
+            # rounding) and meaningless without int8 weights
+            if not quantize:
+                raise ValueError(
+                    "quantize_act (W8A8 prefill) requires quantize=True — "
+                    "without int8 weights there is no s8xs8 matmul to run"
+                )
+            import dataclasses
+
+            self.cfg = dataclasses.replace(self.cfg, w8a8_prefill=True)
         self.interpret = bool(interpret)
         # Pallas flash prefill: "auto" enables it on real TPU (the kernel
         # needs Mosaic; CPU tests pass interpret=True explicitly). Under a

@@ -141,6 +141,11 @@ class PipelineConfig:
     # w.r.t. the quantized weights; models/quant.py). The engine's decode is
     # weight-bandwidth-bound, so this is most of the single-chip speedup
     quantize: bool = False
+    # W8A8 prefill: ALSO int8-quantize activations (per-token absmax) into
+    # the prefill matmuls — double-rate s8xs8 MXU dots. LOSSY (activation
+    # rounding ~1/127 per matmul input), so off by default; quality runs
+    # should A/B it. Requires quantize=True
+    quantize_act: bool = False
     dtype: str = "bfloat16"
     # local HF checkpoint dir (config.json + *.safetensors + tokenizer files)
     # for the tpu backend: weights are converted via models.convert and the
@@ -183,6 +188,17 @@ class PipelineConfig:
                 f"quantize requires backend='tpu' (got {self.backend!r}); "
                 "other backends would silently run full-precision while the "
                 "run record claims int8"
+            )
+        if self.quantize_act and not self.quantize:
+            raise ValueError(
+                "quantize_act (W8A8 prefill) requires quantize=True — "
+                "without int8 weights there is no s8xs8 matmul to run"
+            )
+        if self.quantize_act and self.long_context:
+            raise ValueError(
+                "quantize_act is one-chip-engine only; the long-context "
+                "ring prefill would silently run weight-only while the run "
+                "record claims W8A8"
             )
         if self.long_context:
             if self.backend != "tpu":

@@ -50,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
         "lossy int8 mode is opt-in via --quantize-kv-long",
     )
     p.add_argument(
+        "--quantize-act", action="store_true",
+        help="W8A8 prefill: int8-quantize activations (per-token absmax) "
+        "into the int8-weight matmuls — double-rate MXU dots on prefill. "
+        "LOSSY (activation rounding); A/B against --quantize alone for "
+        "quality runs. Requires --quantize",
+    )
+    p.add_argument(
         "--quantize-kv-long", action="store_true",
         help="int8-quantize the long-context prefill KV cache (halves "
         "ring-decode HBM traffic per step). LOSSY: cached K/V round-trip "
@@ -148,6 +155,7 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         long_context=args.long_context,
         long_context_quantize_kv=args.quantize_kv_long,
         quantize=args.quantize,
+        quantize_act=args.quantize_act,
         tree_json_path=args.tree_json,
         max_depth=args.max_depth,
         **{

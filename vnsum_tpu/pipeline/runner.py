@@ -149,6 +149,7 @@ class PipelineRunner:
                 batch_size=cfg.batch_size,
                 max_new_tokens=cfg.max_new_tokens,
                 quantize=cfg.quantize,
+                quantize_act=cfg.quantize_act,
             )
         raise ValueError(f"unknown backend {cfg.backend!r}")
 
