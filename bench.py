@@ -115,12 +115,28 @@ def _pick_ragged_eos(outs: list[str], tok, budget: int = 128) -> tuple[int, ...]
     return (int(best),)
 
 
+def e2e_engine_kwargs(tok_spec, params) -> dict:
+    """ONE copy of the e2e engine configuration — the headline e2e run, the
+    instrumented budget pass, and the W8A8 A/B row must all measure the
+    same shape (chunk_size 7800 -> S=8192 bucket, B=8 at the HBM ceiling,
+    int8 weights)."""
+    from vnsum_tpu.models import llama32_3b
+
+    return dict(
+        model_config=llama32_3b(max_seq_len=8448),
+        tokenizer=tok_spec,
+        params=params,
+        batch_size=8,
+        max_new_tokens=128,
+        quantize=True,
+    )
+
+
 def run_e2e_bench(params) -> tuple[dict, str, object, str, tuple]:
     # returns (metrics, corpus root, live backend, tokenizer spec, eos ids)
     from vnsum_tpu.backend.engine import TpuBackend
     from vnsum_tpu.core.config import GenerationConfig, PipelineConfig
     from vnsum_tpu.data.synthesize import synthesize_corpus
-    from vnsum_tpu.models import llama32_3b
     from vnsum_tpu.pipeline.runner import PipelineRunner
 
     root = tempfile.mkdtemp(prefix="vnsum_bench_")
@@ -166,18 +182,11 @@ def run_e2e_bench(params) -> tuple[dict, str, object, str, tuple]:
 
     # chunk_size 7800 BPE tokens lands prompts in the S=8192 bucket; int8 KV
     # keeps 8 rows of 8320-token cache (+ int8 weights + the ~4 GB of
-    # prefill transients at S=8192) inside one v5e chip — B=16 OOMs
-    backend = TpuBackend(
-        model_config=llama32_3b(max_seq_len=8448),
-        tokenizer=tok_spec,
-        params=params,  # shared with the map bench — no re-init/re-quantize
-        batch_size=8,
-        max_new_tokens=128,
-        quantize=True,
-        # continuous="auto" correctly resolves to the ONE-SHOT program at
-        # B=8: the measured A/B (artifacts/compaction_ab.json) shows the
-        # segmented path losing ~33% token-normalized at this shape
-    )
+    # prefill transients at S=8192) inside one v5e chip — B=16 OOMs.
+    # continuous="auto" correctly resolves to the ONE-SHOT program at B=8:
+    # the measured A/B (artifacts/compaction_ab.json) shows the segmented
+    # path losing ~33% token-normalized at this shape
+    backend = TpuBackend(**e2e_engine_kwargs(tok_spec, params))
     cfg = PipelineConfig(
         approach="mapreduce",
         models=["llama3.2-3b"],
@@ -307,17 +316,10 @@ def run_device_budget(params, root: str, tok_spec, eos) -> dict:
 
     from vnsum_tpu.backend.engine import EngineStats, TpuBackend
     from vnsum_tpu.core.config import GenerationConfig, PipelineConfig
-    from vnsum_tpu.models import llama32_3b
     from vnsum_tpu.pipeline.runner import PipelineRunner
 
     backend = TpuBackend(
-        model_config=llama32_3b(max_seq_len=8448),
-        tokenizer=tok_spec,
-        params=params,
-        batch_size=8,
-        max_new_tokens=128,
-        quantize=True,
-        instrument=True,
+        **e2e_engine_kwargs(tok_spec, params), instrument=True
     )
     if eos is None:
         # standalone use (scripts/measure_device_budget.py): run the same
@@ -534,11 +536,29 @@ def main() -> int:
         e2e_backend, "mapreduce_critique", corpus_root, tok_spec
     )
 
-    # the instrumented engine compiles its own split programs — release the
-    # main engine's executables first (same HBM-fragmentation reasoning as
-    # the map->e2e handoff above)
+    # release the main engine's executables before the remaining phases
+    # (same HBM-fragmentation reasoning as the map->e2e handoff above)
     del e2e_backend
     gc.collect()
+
+    # W8A8 opt-in at the e2e workload (4 docs, summarize-only): the
+    # headline stays weight-only-exact; this row tracks what the lossy
+    # double-rate prefill buys end-to-end (PERF.md finding 18)
+    from vnsum_tpu.core.config import GenerationConfig
+
+    w8a8_backend = TpuBackend(
+        **e2e_engine_kwargs(tok_spec, params),
+        quantize_act=True,
+        generation=GenerationConfig(
+            max_new_tokens=128, temperature=1.0, seed=11, eos_ids=eos
+        ),
+    )
+    w8a8_res = run_strategy_bench(
+        w8a8_backend, "mapreduce", corpus_root, tok_spec
+    )
+    del w8a8_backend
+    gc.collect()
+
     budget_res = run_device_budget(params, corpus_root, tok_spec, eos)
 
     chunks_per_sec = map_res["chunks_per_sec"]
@@ -555,6 +575,7 @@ def main() -> int:
                 "e2e_iterative": iter_res,
                 "e2e_hierarchical": hier_res,
                 "e2e_critique": crit_res,
+                "e2e_w8a8_mapreduce": w8a8_res,
                 "device_budget": budget_res,
             }
         )
