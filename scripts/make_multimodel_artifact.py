@@ -158,28 +158,43 @@ def main() -> int:
         layer_is_global=tuple((i + 1) % 6 == 0 for i in range(8)),
     )
 
-    cfg = PipelineConfig(
-        approach="mapreduce",
-        models=["sweep-llama-8l", "sweep-qwen3-0.6b", "sweep-gemma3-8l"],
-        backend="tpu",
-        docs_dir=f"{root}/c/doc",
-        summary_dir=f"{root}/c/summary",
-        generated_summaries_dir=f"{root}/gen",
-        results_dir=f"{root}/results",
-        logs_dir=f"{root}/logs",
-        chunk_size=3_800,
-        chunk_overlap=100,
-        token_max=3_000,
-        max_new_tokens=64,
-        batch_size=4,
-        tokenizer="byte",
-    )
+    def make_cfg(tag: str) -> PipelineConfig:
+        return PipelineConfig(
+            approach="mapreduce",
+            models=["sweep-llama-8l", "sweep-qwen3-0.6b", "sweep-gemma3-8l"],
+            backend="tpu",
+            docs_dir=f"{root}/c/doc",
+            summary_dir=f"{root}/c/summary",
+            generated_summaries_dir=f"{root}/gen_{tag}",
+            results_dir=f"{root}/results_{tag}",
+            logs_dir=f"{root}/logs",
+            chunk_size=3_800,
+            chunk_overlap=100,
+            token_max=3_000,
+            max_new_tokens=64,
+            batch_size=4,
+            tokenizer="byte",
+        )
+
+    # TWO passes: the first compiles every per-family program (first-compile
+    # cost is wildly family-dependent — the r4 artifact recorded
+    # sweep-gemma3-8l at 50.1 s vs sweep-llama-8l at 26.9 s, and the r5
+    # profile (artifacts/sweep_anomaly_profile.json) showed steady-state
+    # PARITY: the whole 1.9x was compile pollution in total_time, not a
+    # kernel fallback. The second pass is the measured one.
+    PipelineRunner(make_cfg("warm")).run()
+    cfg = make_cfg("meas")
     runner = PipelineRunner(cfg)
     t0 = time.time()
     results = runner.run()
     elapsed = time.time() - t0
 
     rec: dict = {
+        "measurement": (
+            "second (warm) pipeline pass — compile excluded; see "
+            "artifacts/sweep_anomaly_profile.json for the per-phase "
+            "instrumented comparison and the r4 1.9x attribution"
+        ),
         "families": {
             "sweep-llama-8l": "Llama GQA (3B architecture, 8 layers)",
             "sweep-qwen3-0.6b": "Qwen3 QK-norm (0.6B real shape)",

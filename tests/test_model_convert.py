@@ -236,88 +236,17 @@ def test_save_hf_checkpoint_untied(tmp_path):
 
 # -- four-family trained generation parity (VERDICT r3 #4) -------------------
 
-_GEN_CORPUS = [
-    "Quốc hội đã thông qua nghị quyết về phát triển kinh tế xã hội. "
-    "Chính phủ sẽ triển khai các giải pháp trọng tâm trong năm nay.",
-    "Tòa án nhân dân xét xử vụ án theo đúng quy định của pháp luật. "
-    "Bản án được tuyên sau khi hội đồng nghị án.",
-    "Nhà trường tổ chức kỳ thi tốt nghiệp cho học sinh khối mười hai. "
-    "Kết quả sẽ được công bố trong tuần tới.",
-] * 6
-
-_FAMILIES = {
-    "llama": (
-        "LlamaForCausalLM", "LlamaConfig",
-        dict(hidden_size=64, intermediate_size=128, num_hidden_layers=2,
-             num_attention_heads=4, num_key_value_heads=2, head_dim=16,
-             max_position_embeddings=256, rope_theta=10000.0,
-             rms_norm_eps=1e-5, tie_word_embeddings=True),
-    ),
-    "qwen3": (
-        "Qwen3ForCausalLM", "Qwen3Config",
-        dict(hidden_size=64, intermediate_size=128, num_hidden_layers=2,
-             num_attention_heads=4, num_key_value_heads=2, head_dim=16,
-             max_position_embeddings=256, rope_theta=10000.0,
-             rms_norm_eps=1e-6, tie_word_embeddings=True),
-    ),
-    "gemma3": (
-        "Gemma3ForCausalLM", "Gemma3TextConfig",
-        dict(hidden_size=64, intermediate_size=128, num_hidden_layers=4,
-             num_attention_heads=4, num_key_value_heads=2, head_dim=16,
-             max_position_embeddings=256, rope_theta=10000.0,
-             rope_local_base_freq=5000.0, rms_norm_eps=1e-6,
-             tie_word_embeddings=True, query_pre_attn_scalar=32,
-             sliding_window=8,
-             layer_types=["sliding_attention", "sliding_attention",
-                          "full_attention", "sliding_attention"]),
-    ),
-    "phi": (
-        "Phi3ForCausalLM", "Phi3Config",
-        dict(hidden_size=64, intermediate_size=128, num_hidden_layers=2,
-             num_attention_heads=4, num_key_value_heads=2,
-             max_position_embeddings=256, rope_theta=10000.0,
-             rms_norm_eps=1e-5, tie_word_embeddings=False),
-    ),
-}
+# harness lifted to models/fixtures.py so artifact scripts train the same
+# checkpoints (VERDICT r4 #2 quality A/B); the test keeps its local aliases
+from vnsum_tpu.models.fixtures import (  # noqa: E402
+    GEN_CORPUS as _GEN_CORPUS,
+    TRAINED_FAMILIES as _FAMILIES,
+    train_tiny_family as _train_tiny_family_lib,
+)
 
 
 def _train_tiny_family(family: str, out_dir, steps: int = 40):
-    from vnsum_tpu.models.fixtures import train_bpe_tokenizer
-
-    model_name, cfg_name, kw = _FAMILIES[family]
-    hf_tok = train_bpe_tokenizer(_GEN_CORPUS, vocab_size=384)
-    torch.manual_seed(0)
-    cfg = getattr(transformers, cfg_name)(
-        vocab_size=len(hf_tok),
-        bos_token_id=hf_tok.bos_token_id,
-        eos_token_id=hf_tok.eos_token_id,
-        pad_token_id=hf_tok.pad_token_id,
-        **kw,
-    )
-    model = getattr(transformers, model_name)(cfg)
-
-    ids: list[int] = []
-    for text in _GEN_CORPUS:
-        ids.extend(hf_tok.encode(text))
-        ids.append(hf_tok.eos_token_id)
-    seq = 64
-    n = len(ids) // seq
-    data = torch.tensor(ids[: n * seq], dtype=torch.long).view(n, seq)
-    opt = torch.optim.AdamW(model.parameters(), lr=3e-3)
-    gen = torch.Generator().manual_seed(0)
-    model.train()
-    for _ in range(steps):
-        rows = torch.randint(0, n, (min(8, n),), generator=gen)
-        batch = data[rows]
-        loss = model(input_ids=batch, labels=batch).loss
-        opt.zero_grad()
-        loss.backward()
-        opt.step()
-    model.eval()
-    model.save_pretrained(out_dir, safe_serialization=True)
-    hf_tok.save_pretrained(out_dir)
-    return model, hf_tok
-
+    return _train_tiny_family_lib(family, out_dir, steps=steps)
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
 def test_trained_generation_string_parity(family, tmp_path):

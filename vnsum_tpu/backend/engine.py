@@ -564,6 +564,8 @@ class TpuBackend:
             raise ValueError("choices must differ in their first token")
         choice_dev = jnp.asarray(ids, dtype=jnp.int32)
 
+        self.stats.calls += 1
+        self.stats.prompts += len(prompts)
         max_input = self.cfg.max_seq_len
         encoded: list[list[int]] = []
         t_enc = time.time()
@@ -572,6 +574,7 @@ class TpuBackend:
             if len(tok_ids) > max_input:
                 tok_ids = [tok_ids[0]] + tok_ids[-(max_input - 1):]
             encoded.append(tok_ids)
+            self.stats.prompt_tokens += len(tok_ids)
         self.stats.add_phase("tokenize_host", time.time() - t_enc)
 
         order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
@@ -588,12 +591,18 @@ class TpuBackend:
                 self._fns[key] = self._make_choice_fn(B, S, len(ids))
                 logger.info("built choice fn for bucket B=%d S=%d", B, S)
                 self.stats.compile_seconds += time.time() - t0
+            t_disp = time.time()
             with annotate(f"choice[B={B},S={S}]"):
                 idx = self._fns[key](
                     self.params, tokens, pad_lens, choice_dev
                 )
-            idx_h = np.asarray(idx)
+            idx_h = np.asarray(idx)  # fetch = sync, so the time is real
+            if self.instrument:
+                self.stats.add_phase("choice", time.time() - t_disp)
             self.stats.batches += 1
+            self.stats.by_bucket[(B, S)] = (
+                self.stats.by_bucket.get((B, S), 0) + 1
+            )
             for row, i in enumerate(group):
                 results[i] = int(idx_h[row])
         return results

