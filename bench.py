@@ -117,9 +117,18 @@ def _pick_ragged_eos(outs: list[str], tok, budget: int = 128) -> tuple[int, ...]
 
 def e2e_engine_kwargs(tok_spec, params) -> dict:
     """ONE copy of the e2e engine configuration — the headline e2e run, the
-    instrumented budget pass, and the W8A8 A/B row must all measure the
-    same shape (chunk_size 7800 -> S=8192 bucket, B=8 at the HBM ceiling,
-    int8 weights)."""
+    instrumented budget pass, and the weight-only A/B row must all measure
+    the same shape (chunk_size 7800 -> S=8192 bucket, B=8 at the HBM
+    ceiling, int8 weights).
+
+    W8A8 prefill is the DEFAULT here as of round 5: its quality cost is
+    measured and bounded (artifacts/quality_lossy_ab.json — within 0.5pp
+    string agreement / 0.005 ROUGE-L of the int8-weights+int8-KV arm on
+    the four trained family fixtures, per the pre-registered promotion
+    rule), and it buys 1.25x on the dominant prefill dispatch
+    (artifacts/w8a8_ab.json, PERF.md finding 18). The weight-only-exact
+    path stays one flag away (quantize_act=False) and keeps its own bench
+    row."""
     from vnsum_tpu.models import llama32_3b
 
     return dict(
@@ -129,6 +138,7 @@ def e2e_engine_kwargs(tok_spec, params) -> dict:
         batch_size=8,
         max_new_tokens=128,
         quantize=True,
+        quantize_act=True,
     )
 
 
@@ -428,10 +438,29 @@ def run_device_budget(params, root: str, tok_spec, eos) -> dict:
     )
     roofline = dec_bytes / (dec * HBM_BYTES_PER_S) if dec else 0.0
 
+    # one-shot comparison pass (VERDICT r4 weak #5): the SAME 4 docs through
+    # a production (instrument=False) engine sharing these weights, so the
+    # few-percent structural delta of the split instrument programs is
+    # MEASURED on identical input rather than asserted from compaction_ab
+    oneshot = TpuBackend(**e2e_engine_kwargs(tok_spec, live_params))
+    oneshot.gen_cfg = backend.gen_cfg
+    # warm pass first (trace + cache-load), mirroring the instrument arm's
+    # two-pass discipline — otherwise the delta is swamped by compile
+    for tag in ("gen_budget_oneshot_warm", "gen_budget_oneshot"):
+        t0 = time.time()
+        rec_1 = PipelineRunner(
+            make_cfg(tag), backend_factory=lambda model: oneshot
+        ).run_summarization_for_model("llama3.2-3b")
+        oneshot_wall = time.time() - t0
+    if not rec_1.successful:
+        raise RuntimeError("one-shot comparison pass: all documents failed")
+
     out = {
         "docs": rec.successful,
         "chunks": rec.total_chunks,
         "wall_s": round(wall, 1),
+        "oneshot_wall_s": round(oneshot_wall, 1),
+        "instrument_overhead_frac": round(wall / oneshot_wall - 1, 4),
         "prefill_s": round(pre, 1),
         "decode_s": round(dec, 1),
         "tokenize_host_s": round(tok_h, 1),
@@ -541,22 +570,22 @@ def main() -> int:
     del e2e_backend
     gc.collect()
 
-    # W8A8 opt-in at the e2e workload (4 docs, summarize-only): the
-    # headline stays weight-only-exact; this row tracks what the lossy
-    # double-rate prefill buys end-to-end (PERF.md finding 18)
+    # weight-only-exact A/B at the e2e workload (4 docs, summarize-only):
+    # W8A8 is the headline default since round 5 (quality bound:
+    # artifacts/quality_lossy_ab.json); this row keeps the exact path's
+    # cost visible so the 1.25x prefill claim stays continuously measured
     from vnsum_tpu.core.config import GenerationConfig
 
-    w8a8_backend = TpuBackend(
-        **e2e_engine_kwargs(tok_spec, params),
-        quantize_act=True,
+    exact_backend = TpuBackend(
+        **{**e2e_engine_kwargs(tok_spec, params), "quantize_act": False},
         generation=GenerationConfig(
             max_new_tokens=128, temperature=1.0, seed=11, eos_ids=eos
         ),
     )
-    w8a8_res = run_strategy_bench(
-        w8a8_backend, "mapreduce", corpus_root, tok_spec
+    exact_res = run_strategy_bench(
+        exact_backend, "mapreduce", corpus_root, tok_spec
     )
-    del w8a8_backend
+    del exact_backend
     gc.collect()
 
     budget_res = run_device_budget(params, corpus_root, tok_spec, eos)
@@ -575,7 +604,7 @@ def main() -> int:
                 "e2e_iterative": iter_res,
                 "e2e_hierarchical": hier_res,
                 "e2e_critique": crit_res,
-                "e2e_w8a8_mapreduce": w8a8_res,
+                "e2e_weight_only_mapreduce": exact_res,
                 "device_budget": budget_res,
             }
         )

@@ -1,4 +1,5 @@
-"""One-chip validation of the kernelized long-context decode (VERDICT r3 #5).
+"""One-chip validation of the kernelized long-context decode (VERDICT r3 #5,
+hardened per VERDICT r4 #4).
 
 Runs the REAL llama3.2-3b shapes through the long-context path on a
 degenerate seq=1 mesh (one chip), dense einsum shard partial vs the
@@ -6,9 +7,15 @@ stacked-cache Pallas kernel partial, at the e2e-relevant shape
 (B=8, ~7.9k-token prompts, 64 sampled new tokens). At seq=1 the shard IS
 the whole cache, so the A/B isolates exactly what the kernel removes: the
 per-step per-layer `dynamic_index_in_dim` extraction copy (~3.8 GB/step of
-int8 K/V at this shape) plus the dense lowering's layout copies. If an arm
-does not fit the chip at a shape, that is recorded and the ladder steps
-down — "kernel runs where dense cannot" is itself the finding.
+int8 K/V at this shape) plus the dense lowering's layout copies.
+
+The r4 attempt lost every copy-dominated shape to transient HTTP 500s from
+the remote-compile service and proved only the expected tie at B=2/4k
+(weight-dominated). This version: (1) retries transient compile-service
+failures with backoff (deterministic OOMs fail fast — the boundary is
+data); (2) brackets with intermediate shapes (B=8/6k, B=4/6k); (3) runs
+the weight-dominated control first, then measures copy-dominated shapes
+until one pair lands, keeping the exhaustive attempt log either way.
 
 Writes artifacts/longcontext_kernel_onechip.json.
 """
@@ -69,42 +76,106 @@ def main() -> int:
     rec: dict = {
         "config": "llama3.2-3b int8 weights + int8 prefill cache, 64 new "
                   "tokens sampled T=1.0, mesh seq=1 (one chip)",
-        "failures": [],
+        "attempt_log": [],
+        "shapes": [],
     }
-    for B, tokens in ((8, 7900), (4, 7900), (2, 4000)):
+    out = REPO / "artifacts" / "longcontext_kernel_onechip.json"
+
+    _TRANSIENT = ("500", "502", "503", "UNAVAILABLE", "DEADLINE",
+                  "INTERNAL", "connection", "Connection", "timed out")
+
+    def attempt_with_retries(kernel: bool, B: int, tokens: int, tries=3):
+        name = "kernel" if kernel else "dense"
+        for t in range(tries):
+            try:
+                row = run_arm(kernel, params, cfg, mesh, B, tokens)
+                rec["attempt_log"].append(
+                    {"arm": name, "B": B, "prompt_tokens": tokens,
+                     "try": t + 1, "ok": True}
+                )
+                print(row, file=sys.stderr)
+                return row
+            except Exception as e:
+                msg = str(e)
+                rec["attempt_log"].append(
+                    {"arm": name, "B": B, "prompt_tokens": tokens,
+                     "try": t + 1, "ok": False, "error": msg[:300]}
+                )
+                print(f"{name} B={B}/{tokens} try {t + 1} failed: "
+                      f"{msg[:160]}", file=sys.stderr)
+                gc.collect()
+                # only the remote-compile service's transient failures are
+                # worth a retry (on success the program lands in the
+                # persistent cache, so a retry never re-pays what already
+                # compiled); an OOM at these shapes is deterministic — the
+                # capacity boundary is data, retrying it is pure waste
+                if "RESOURCE_EXHAUSTED" in msg or not any(
+                    s in msg for s in _TRANSIENT
+                ):
+                    return None
+                if t + 1 < tries:
+                    time.sleep(20 * (t + 1))
+        return None
+
+    # SMALL shape first: the weight-dominated tie is the control row the
+    # claim needs (r4 measured 1.01x there; re-measuring keeps the artifact
+    # self-contained after this rewrite) — then copy-dominated big-to-small
+    # (B=8/7.9k: ~3.8 GB of K/V extraction per step vs 3.2 GB of weights),
+    # with 6k brackets between the r4 failures and the known-good shape
+    for B, tokens in ((2, 4000), (8, 7900), (8, 6000), (4, 7900), (4, 6000)):
         arms = {}
         for kernel in (False, True):
-            name = "kernel" if kernel else "dense"
-            try:
-                arms[name] = run_arm(kernel, params, cfg, mesh, B, tokens)
-                print(arms[name], file=sys.stderr)
-            except Exception as e:
-                rec["failures"].append(
-                    {"arm": name, "B": B, "prompt_tokens": tokens,
-                     "error": str(e)[:300]}
-                )
-                print(f"{name} B={B} failed: {str(e)[:160]}", file=sys.stderr)
+            row = attempt_with_retries(kernel, B, tokens)
+            if row is not None:
+                arms["kernel" if kernel else "dense"] = row
             gc.collect()
+        shape_rec: dict = {"B": B, "prompt_tokens": tokens, **arms}
         if "dense" in arms and "kernel" in arms:
-            rec["dense"], rec["kernel"] = arms["dense"], arms["kernel"]
-            rec["warm_speedup_kernel_vs_dense"] = round(
+            shape_rec["warm_speedup_kernel_vs_dense"] = round(
                 arms["dense"]["warm_run_s"]
                 / max(arms["kernel"]["warm_run_s"], 1e-9), 2
             )
-            break
-        if "kernel" in arms and "dense" not in arms:
-            rec["kernel"] = arms["kernel"]
-            rec["note"] = (
-                "dense partial did not fit at this shape; the kernel arm "
-                "ran — the extraction-copy savings ARE the capacity margin"
+        elif "kernel" in arms:
+            shape_rec["note"] = (
+                "dense arm failed at this shape; kernel ran — the "
+                "extraction-copy savings ARE the capacity margin"
             )
+        if arms:
+            rec["shapes"].append(shape_rec)
+        # checkpoint after every shape: a later OOM/crash must not lose
+        # measured rows
+        out.write_text(json.dumps(rec, indent=2))
+        # stop once BOTH rows the claim needs are measured — the small-shape
+        # weight-dominated control AND a copy-dominated pair; further
+        # brackets are compile-budget without information
+        done_pairs = [
+            s for s in rec["shapes"] if "warm_speedup_kernel_vs_dense" in s
+        ]
+        have_control = any(
+            s["B"] * s["prompt_tokens"] <= 2 * 4000 for s in done_pairs
+        )
+        have_big = any(
+            s["B"] * s["prompt_tokens"] >= 8 * 6000 for s in done_pairs
+        )
+        if have_control and have_big:
             break
 
-    out = REPO / "artifacts" / "longcontext_kernel_onechip.json"
+    rec["headline"] = next(
+        (
+            {
+                "B": s["B"], "prompt_tokens": s["prompt_tokens"],
+                "warm_speedup_kernel_vs_dense":
+                    s["warm_speedup_kernel_vs_dense"],
+            }
+            for s in rec["shapes"]
+            if "warm_speedup_kernel_vs_dense" in s
+            and s["B"] * s["prompt_tokens"] >= 8 * 6000
+        ),
+        None,
+    )
     out.write_text(json.dumps(rec, indent=2))
-    print(json.dumps({"ok": True,
-                      "speedup": rec.get("warm_speedup_kernel_vs_dense"),
-                      "failures": len(rec["failures"])}))
+    print(json.dumps({"ok": True, "headline": rec["headline"],
+                      "attempts": len(rec["attempt_log"])}))
     return 0
 
 
