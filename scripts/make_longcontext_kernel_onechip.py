@@ -62,16 +62,47 @@ def run_arm(decode_kernel: bool, params, cfg, mesh, B: int, tokens: int):
     }
 
 
+def narrow_mechanism_config():
+    """A dim-1024/16-layer Llama variant where K/V extraction DOMINATES
+    weights at shapes the remote-compile service accepts.
+
+    The copy-vs-weights ratio is (B*C*KV*hd*2) / per-layer-weight-bytes —
+    independent of layer count — so at the 3B's 99 MB/layer the ratio
+    needs B*C >= ~48k, and every such long-context program deterministically
+    kills the compile helper (attempt_log). This config has 17 MB/layer:
+    at B=4/C~4160 extraction is ~3.6x weights, same kernels, same code
+    path, at the B=2/4k-class program size the service compiles."""
+    from vnsum_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=32_768, dim=1024, n_layers=16, n_heads=8, n_kv_heads=8,
+        head_dim=128, intermediate=4096, max_seq_len=8192,
+        use_llama3_rope_scaling=False, rope_theta=500_000.0,
+    )
+
+
 def main() -> int:
+    import argparse
+
     from vnsum_tpu.core.jax_cache import enable_compilation_cache
     from vnsum_tpu.models import jitted_init, llama32_3b
     from vnsum_tpu.models.llama import init_params
     from vnsum_tpu.parallel.mesh import make_mesh
 
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--phase", default="all", choices=("all", "ladder", "narrow"),
+        help="'narrow' appends the mechanism rows to an existing artifact "
+             "without re-running the 3B ladder",
+    )
+    args = ap.parse_args()
+
     enable_compilation_cache()
     cfg = llama32_3b(max_seq_len=8192)
     mesh = make_mesh({"data": 1, "model": 1, "seq": 1})
-    params = jitted_init(init_params, cfg, 0)
+    params = None
+    if args.phase in ("all", "ladder"):
+        params = jitted_init(init_params, cfg, 0)
 
     rec: dict = {
         "config": "llama3.2-3b int8 weights + int8 prefill cache, 64 new "
@@ -80,15 +111,21 @@ def main() -> int:
         "shapes": [],
     }
     out = REPO / "artifacts" / "longcontext_kernel_onechip.json"
+    if args.phase == "narrow" and out.exists():
+        rec = json.loads(out.read_text())  # keep the measured 3B rows
 
     _TRANSIENT = ("500", "502", "503", "UNAVAILABLE", "DEADLINE",
                   "INTERNAL", "connection", "Connection", "timed out")
 
-    def attempt_with_retries(kernel: bool, B: int, tokens: int, tries=3):
+    def attempt_with_retries(kernel: bool, B: int, tokens: int, tries=3,
+                             cfg_=None, params_=None):
         name = "kernel" if kernel else "dense"
         for t in range(tries):
             try:
-                row = run_arm(kernel, params, cfg, mesh, B, tokens)
+                row = run_arm(
+                    kernel, params_ if params_ is not None else params,
+                    cfg_ if cfg_ is not None else cfg, mesh, B, tokens,
+                )
                 rec["attempt_log"].append(
                     {"arm": name, "B": B, "prompt_tokens": tokens,
                      "try": t + 1, "ok": True}
@@ -122,7 +159,11 @@ def main() -> int:
     # self-contained after this rewrite) — then copy-dominated big-to-small
     # (B=8/7.9k: ~3.8 GB of K/V extraction per step vs 3.2 GB of weights),
     # with 6k brackets between the r4 failures and the known-good shape
-    for B, tokens in ((2, 4000), (8, 7900), (8, 6000), (4, 7900), (4, 6000)):
+    ladder = (
+        ((2, 4000), (8, 7900), (8, 6000), (4, 7900), (4, 6000))
+        if args.phase in ("all", "ladder") else ()
+    )
+    for B, tokens in ladder:
         arms = {}
         for kernel in (False, True):
             row = attempt_with_retries(kernel, B, tokens)
@@ -173,6 +214,48 @@ def main() -> int:
         ),
         None,
     )
+
+    if args.phase in ("all", "narrow") and rec["headline"] is None:
+        # mechanism demonstration (VERDICT r4 #4 fallback, beyond the
+        # attempt log): every 3B shape past B=2/4k deterministically kills
+        # the compile helper, so demonstrate the extraction-copy claim at a
+        # config whose PER-LAYER weights are small enough that B=4/4k is
+        # already ~3.6x copy-dominated — same kernels, same code path,
+        # program-size class the service compiles
+        del params
+        gc.collect()
+        ncfg = narrow_mechanism_config()
+        nparams = jitted_init(init_params, ncfg, 1)
+        narrow_rows = []
+        for B, tokens in ((4, 4000), (2, 4000)):
+            arms = {}
+            for kernel in (False, True):
+                row = attempt_with_retries(
+                    kernel, B, tokens, cfg_=ncfg, params_=nparams
+                )
+                if row is not None:
+                    arms["kernel" if kernel else "dense"] = row
+                gc.collect()
+            nrow: dict = {"B": B, "prompt_tokens": tokens, **arms}
+            if "dense" in arms and "kernel" in arms:
+                nrow["warm_speedup_kernel_vs_dense"] = round(
+                    arms["dense"]["warm_run_s"]
+                    / max(arms["kernel"]["warm_run_s"], 1e-9), 2
+                )
+            if arms:
+                narrow_rows.append(nrow)
+            rec["narrow_mechanism"] = {
+                "config": (
+                    "dim-1024/16L/8kv/hd128, int8+int8KV: 17 MB/layer "
+                    "weights -> extraction/weights ~3.6x at B=4/C~4160 "
+                    "(vs 0.34x at the 3B control shape)"
+                ),
+                "shapes": narrow_rows,
+            }
+            out.write_text(json.dumps(rec, indent=2))
+            if narrow_rows and "warm_speedup_kernel_vs_dense" in narrow_rows[0]:
+                break  # the copy-dominated row landed; the control is optional
+
     out.write_text(json.dumps(rec, indent=2))
     print(json.dumps({"ok": True, "headline": rec["headline"],
                       "attempts": len(rec["attempt_log"])}))
