@@ -128,17 +128,26 @@ def e2e_engine_kwargs(tok_spec, params) -> dict:
     rule), and it buys 1.25x on the dominant prefill dispatch
     (artifacts/w8a8_ab.json, PERF.md finding 18). The weight-only-exact
     path stays one flag away (quantize_act=False) and keeps its own bench
-    row."""
+    row.
+
+    B=16 + chunked prefill is ALSO the round-5 default: whole-prompt
+    prefill transients were what capped the batch at 8 next to the int8 KV
+    cache; prefill_chunk_tokens=2048 caps them at a chunk's worth, and the
+    measured A/B (artifacts/b16_chunked_prefill.json) shows one B=16
+    dispatch beating two B=8 dispatches 1.10x overall (decode 1.36x —
+    weight reads amortize over twice the rows; prefill flat; exact same
+    math, engine-level chunked==whole equivalence test)."""
     from vnsum_tpu.models import llama32_3b
 
     return dict(
         model_config=llama32_3b(max_seq_len=8448),
         tokenizer=tok_spec,
         params=params,
-        batch_size=8,
+        batch_size=16,
         max_new_tokens=128,
         quantize=True,
         quantize_act=True,
+        prefill_chunk_tokens=2048,
     )
 
 
@@ -214,7 +223,7 @@ def run_e2e_bench(params) -> tuple[dict, str, object, str, tuple]:
         # silently truncated by the engine
         token_max=6_000,
         max_new_tokens=128,
-        batch_size=8,
+        batch_size=16,
         tokenizer=tok_spec,
     )
     # random-init weights never emit the true EOS, so decode would always
@@ -232,13 +241,15 @@ def run_e2e_bench(params) -> tuple[dict, str, object, str, tuple]:
     # by the measured compression so every probe prompt lands in the S=8192
     # bucket the pipeline uses (pre-warming its compile).
     raw = b" ".join(
-        p.read_text(encoding="utf-8").encode("utf-8") for p in doc_paths[:3]
+        p.read_text(encoding="utf-8").encode("utf-8") for p in doc_paths[:6]
     )
     step = int(7_300 * bytes_per_tok)  # ~7.3k BPE tokens -> S=8192 bucket
-    assert len(raw) >= 8 * step, (len(raw), step)
+    nb = backend.batch_size  # probe at FULL batch so the dominant
+    # (B, S=8192) bucket's program is the one warmed
+    assert len(raw) >= nb * step, (len(raw), step)
     probe_prompts = [
         "Tóm tắt: " + raw[i * step : (i + 1) * step].decode("utf-8", "ignore")
-        for i in range(8)
+        for i in range(nb)
     ]
     probe = backend.generate(
         probe_prompts, config=GenerationConfig(temperature=1.0, seed=11)
@@ -372,7 +383,7 @@ def run_device_budget(params, root: str, tok_spec, eos) -> dict:
             chunk_overlap=200,
             token_max=6_000,
             max_new_tokens=128,
-            batch_size=8,
+            batch_size=16,
             tokenizer=tok_spec,
             max_samples=4,
         )
@@ -501,7 +512,7 @@ def run_strategy_bench(backend, approach: str, root: str, tok_spec) -> dict:
         iterative_chunk_overlap=200,
         token_max=6_000,
         max_new_tokens=128,
-        batch_size=8,
+        batch_size=16,
         tokenizer=tok_spec,
         max_samples=4,
         tree_json_path=f"{root}/corpus/document_tree.json",

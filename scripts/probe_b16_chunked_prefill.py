@@ -1,0 +1,153 @@
+"""B=16 decode via chunked prefill — the memory-ceiling experiment.
+
+PERF.md finding 20: at B=8 decode pays 3.2 GB of weight reads per step
+regardless of rows; doubling B nearly halves the per-row weight cost, but
+B=16 at S=8192 has never fit one v5e chip because WHOLE-PROMPT prefill
+transients (q/k/v + MLP intermediates at 16x8192 tokens) blow the budget
+next to the 7.8 GB int8 KV cache. prefill_chunk_tokens caps transients at
+a chunk's worth (the Pallas prefill kernel's q_offset places each chunk's
+queries at their cache slots), so the experiment becomes runnable.
+
+Arms (16 identical ~7.4k-token prompts, e2e engine config, W8A8):
+  baseline_b8      — two B=8 whole-prompt dispatches (today's production)
+  b16_chunk2048    — one B=16 dispatch, prefill in 4 chunks of 2048
+  b16_chunk4096    — one B=16 dispatch, prefill in 2 chunks of 4096 (if
+                     2048 fits, try the cheaper chunk count)
+  b8_chunk4096     — control: chunking at B=8 (isolates chunk overhead
+                     from the batch-size change)
+
+Each arm: compile+warm, then a measured instrumented pass. OOM is a
+recorded outcome, not an error. Writes artifacts/b16_chunked_prefill.json.
+"""
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def run_arm(label: str, tok_spec, prompts, batch: int, chunk: int,
+            gen_cfg) -> dict:
+    import jax
+    import numpy as np
+
+    import bench
+    from vnsum_tpu.backend.engine import EngineStats, TpuBackend
+
+    kw = bench.e2e_engine_kwargs(tok_spec, None)
+    kw.update(
+        batch_size=batch, prefill_chunk_tokens=chunk,
+        max_new_tokens=gen_cfg.max_new_tokens or kw["max_new_tokens"],
+    )
+    try:
+        be = TpuBackend(**kw, instrument=True)
+        t0 = time.time()
+        be.generate(prompts, config=gen_cfg)
+        compile_s = time.time() - t0
+        be.stats = EngineStats()
+        t1 = time.time()
+        be.generate(prompts, config=gen_cfg)
+        wall = time.time() - t1
+        st = be.stats
+        row = {
+            "label": label, "B": batch, "chunk": chunk,
+            "compile_and_warm_s": round(compile_s, 1),
+            "wall_s": round(wall, 2),
+            "prefill_s": round(st.phase_seconds.get("prefill", 0.0), 2),
+            "decode_s": round(st.phase_seconds.get("decode", 0.0), 3),
+            "decode_steps": sum(d["steps"] for d in st.dispatches),
+            "dispatches": st.dispatches,
+        }
+        try:
+            # best-effort; NOTE peak_bytes_in_use is the PROCESS-lifetime
+            # allocator peak, so later arms inherit earlier arms' peak —
+            # fit/no-fit (no OOM) is the per-arm memory signal here
+            ms = jax.local_devices()[0].memory_stats() or {}
+            for k in ("bytes_in_use", "peak_bytes_in_use"):
+                if k in ms:
+                    row[k] = int(ms[k])
+        except Exception:
+            pass
+        del be
+        gc.collect()
+        print(f"{label}: {json.dumps(row)[:360]}", file=sys.stderr)
+        return row
+    except Exception as e:
+        gc.collect()
+        row = {"label": label, "B": batch, "chunk": chunk,
+               "status": "failed", "error": str(e)[:300]}
+        print(f"{label} FAILED: {str(e)[:160]}", file=sys.stderr)
+        return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/b16_chunked_prefill.json")
+    ap.add_argument("--max-new", type=int, default=128)
+    args = ap.parse_args()
+
+    from vnsum_tpu.core.config import GenerationConfig
+    from vnsum_tpu.core.jax_cache import enable_compilation_cache
+    from vnsum_tpu.data.synthesize import synthesize_corpus
+    from vnsum_tpu.models.fixtures import train_bpe_tokenizer
+
+    enable_compilation_cache()
+    root = tempfile.mkdtemp(prefix="vnsum_b16_")
+    synthesize_corpus(
+        f"{root}/corpus", n_docs=4, tokens_per_doc=9_000,
+        summary_tokens=200, seed=7, ragged=0.0,
+    )
+    doc_paths = sorted(Path(f"{root}/corpus/doc").glob("*.txt"))
+    hf_tok = train_bpe_tokenizer(
+        (p.read_text(encoding="utf-8") for p in doc_paths), vocab_size=4096
+    )
+    hf_tok.save_pretrained(f"{root}/tok")
+    tok_spec = f"hf:{root}/tok"
+
+    words = " ".join(p.read_text(encoding="utf-8") for p in doc_paths).split()
+    prompts = []
+    for i in range(16):
+        seg = " ".join(words[(i * 2000) % 20000 : (i * 2000) % 20000 + 7400])
+        prompts.append(f"Tóm tắt văn bản số {i}: " + seg)
+
+    gen_cfg = GenerationConfig(
+        max_new_tokens=args.max_new, temperature=1.0, seed=11
+    )
+    rows = [
+        run_arm("baseline_b8", tok_spec, prompts, 8, 0, gen_cfg),
+        run_arm("b8_chunk4096", tok_spec, prompts, 8, 4096, gen_cfg),
+        run_arm("b16_chunk2048", tok_spec, prompts, 16, 2048, gen_cfg),
+    ]
+    if rows[-1].get("status") != "failed":
+        rows.append(run_arm("b16_chunk4096", tok_spec, prompts, 16, 4096,
+                            gen_cfg))
+
+    rec = {
+        "what": "B=16 decode via chunked prefill (16 prompts, e2e config)",
+        "arms": rows,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    ok = {r["label"]: r for r in rows if r.get("status") != "failed"}
+    if "baseline_b8" in ok:
+        base = ok["baseline_b8"]["wall_s"]
+        for name, r in ok.items():
+            r["speedup_vs_b8"] = round(base / r["wall_s"], 3)
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(rec, indent=2))
+    print(json.dumps({"ok": True, "arms": {
+        r["label"]: r.get("speedup_vs_b8") or r.get("status")
+        for r in rows
+    }}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

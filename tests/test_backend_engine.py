@@ -505,3 +505,40 @@ def test_constrained_judge_requires_capable_backend():
 
     with pytest.raises(ValueError):
         LLMJudge(backend=FakeBackend(), constrained=True)
+
+
+def test_chunked_prefill_matches_whole_prompt():
+    """prefill_chunk_tokens must not change ANY output: same cache state,
+    same first token, same greedy continuation — on both the dense and the
+    (interpret-mode) kernel path. This is the correctness gate for the
+    B=16 memory headroom the chunking exists to buy."""
+    from vnsum_tpu.backend.engine import TpuBackend
+
+    cfg = tiny_llama(max_seq_len=256)
+    prompts = [
+        "văn bản một " * 14,
+        "hai " * 3,
+        "một tài liệu dài hơn hẳn những cái khác " * 4,
+    ]
+    outs = {}
+    for tag, kw in {
+        "whole": dict(),
+        "chunked": dict(prefill_chunk_tokens=128),
+        "chunked_flash": dict(
+            prefill_chunk_tokens=128, flash=True, interpret=True
+        ),
+        "whole_flash": dict(flash=True, interpret=True),
+    }.items():
+        be = TpuBackend(
+            model_config=cfg, batch_size=4, max_new_tokens=12, **kw
+        )
+        outs[tag] = be.generate(prompts)
+    assert outs["chunked"] == outs["whole"]
+    assert outs["chunked_flash"] == outs["whole_flash"]
+
+
+def test_chunked_prefill_rejects_bad_multiple():
+    from vnsum_tpu.backend.engine import TpuBackend
+
+    with pytest.raises(ValueError):
+        TpuBackend(model_config=tiny_llama(), prefill_chunk_tokens=100)

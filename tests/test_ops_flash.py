@@ -148,3 +148,49 @@ def test_unsupported_head_dim_raises():
         flash_prefill_attention(
             q, cache, 0, jnp.zeros((1,), jnp.int32), 2
         )
+
+
+@pytest.mark.parametrize("lo,hi", [(16, 32), (32, 45), (0, 16)])
+def test_flash_q_offset_matches_full(lo, hi):
+    """Chunked prefill: the kernel run on query slice [lo:hi) with
+    q_offset=lo must reproduce the corresponding rows of the whole-prompt
+    run (the cache already holds everything the chunk may attend to —
+    exactly the state the engine's chunk loop produces)."""
+    L, B, S, C, H, KV, hd = 2, 2, 45, 64, 4, 2, 128
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=7)
+    pad = jnp.asarray([0, 6], jnp.int32)
+    full = flash_prefill_attention(
+        q, cache, 1, pad, H // KV, block_q=16, block_k=16, interpret=True
+    )
+    chunk = flash_prefill_attention(
+        q[:, lo:hi], cache, 1, pad, H // KV, None, jnp.int32(lo),
+        block_q=16, block_k=16, interpret=True,
+    )
+    for b in range(2):
+        valid = max(0, int(pad[b]) - lo)  # rows below the pad are garbage
+        np.testing.assert_allclose(
+            np.asarray(full)[b, lo + valid : hi],
+            np.asarray(chunk)[b, valid:],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_flash_q_offset_with_window():
+    """Sliding window + offset: chunk rows still see exactly the last
+    `win` slots (slot-space window is offset-invariant)."""
+    L, B, S, C, H, KV, hd = 1, 1, 40, 48, 2, 1, 128
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=9)
+    pad = jnp.asarray([0], jnp.int32)
+    win = jnp.int32(8)
+    full = flash_prefill_attention(
+        q, cache, 0, pad, H // KV, win, block_q=8, block_k=8, interpret=True
+    )
+    lo, hi = 24, 40
+    chunk = flash_prefill_attention(
+        q[:, lo:hi], cache, 0, pad, H // KV, win, jnp.int32(lo),
+        block_q=8, block_k=8, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(full)[0, lo:hi], np.asarray(chunk)[0],
+        rtol=2e-5, atol=2e-5,
+    )

@@ -116,6 +116,7 @@ class TpuBackend:
         min_batch: int = 8,
         interpret: bool = False,
         instrument: bool = False,
+        prefill_chunk_tokens: int = 0,
     ) -> None:
         from ..core.jax_cache import enable_compilation_cache
 
@@ -197,6 +198,18 @@ class TpuBackend:
         self.continuous = bool(continuous)
         self.segment_tokens = max(segment_tokens, 1)
         self.min_batch = max(min_batch, 1)
+        # prefill in slices of this many tokens (0 = whole prompt): caps
+        # prefill transients at CL tokens' worth so decode batches beyond
+        # the whole-prompt memory ceiling fit (B=16 at S=8192 on one v5e —
+        # measured 1.36x decode / 1.10x whole-dispatch vs 2x B=8,
+        # artifacts/b16_chunked_prefill.json)
+        if prefill_chunk_tokens < 0 or (
+            prefill_chunk_tokens and prefill_chunk_tokens % 128
+        ):
+            raise ValueError(
+                "prefill_chunk_tokens must be a non-negative multiple of 128"
+            )
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
         # instrument=True: run the SPLIT prefill + decode programs (same
         # _make_parts bodies as the one-shot jit, so identical math) with a
         # result-fetch sync between them, so stats.phase_seconds carries a
@@ -280,15 +293,14 @@ class TpuBackend:
         interpret = self.interpret
         layer_window = self._layer_window_fn()
 
+        # prefill runs whole-prompt or in prefill_chunk_tokens slices —
+        # chunking caps transient activations (q/k/v, MLP intermediates)
+        # at a chunk's worth, which is what lets B=16 decode fit at S=8192
+        # (measured 1.36x decode vs 2x B=8 dispatches,
+        # artifacts/b16_chunked_prefill.json); see _prefill_forward
         def prefill_part(params, tokens, pad_lens, seed):
-            cache, prefill_stacked_fn = self._prefill_setup(
-                B, C, use_flash, pad_lens, layer_window
-            )
-            positions = prefill_positions(pad_lens, S)
-            mask = prefill_attention_mask(pad_lens, S, C)
-            logits, cache = forward(
-                params, cfg, tokens, positions, cache, 0, mask,
-                last_only=True, stacked_attention_fn=prefill_stacked_fn,
+            logits, cache = self._prefill_forward(
+                params, tokens, pad_lens, B, S, C, use_flash, layer_window
             )
             base = jax.random.key(seed)
             uids0 = jnp.arange(B, dtype=jnp.int32)
@@ -445,22 +457,11 @@ class TpuBackend:
             return layer_window
         return lambda layer_idx: None
 
-    def _prefill_setup(self, B: int, C: int, use_flash, pad_lens,
-                       layer_window):
-        """(kv cache, stacked attention fn) for a prefill-style forward.
-
-        ONE copy of the cache init + mesh layout pin + flash/sharded-flash
-        selection, shared by prefill_part (_make_parts) and the choice
-        scorer (_make_choice_fn) so the two paths cannot drift. Called
-        inside traced functions — pad_lens is a tracer."""
-        cfg = self.cfg
-        mesh = self.mesh
-        quantize_kv = self.quantize_kv
-        interpret = self.interpret
-        cache = init_kv_cache(cfg, B, C, quantized=quantize_kv)
-        if mesh is not None:
-            # pin the cache layout (batch over data, heads over model)
-            # instead of leaving it to GSPMD propagation
+    def _init_prefill_cache(self, B: int, C: int):
+        """Fresh KV cache with the mesh layout pinned (batch over data,
+        heads over model) instead of left to GSPMD propagation."""
+        cache = init_kv_cache(self.cfg, B, C, quantized=self.quantize_kv)
+        if self.mesh is not None:
             from jax.sharding import NamedSharding
 
             from ..parallel.sharding import cache_specs
@@ -468,30 +469,79 @@ class TpuBackend:
             cache = jax.lax.with_sharding_constraint(
                 cache,
                 jax.tree.map(
-                    lambda s: NamedSharding(mesh, s),
-                    cache_specs(quantized=quantize_kv),
+                    lambda s: NamedSharding(self.mesh, s),
+                    cache_specs(quantized=self.quantize_kv),
                     is_leaf=lambda x: not isinstance(x, dict),
                 ),
             )
-        stacked_fn = None
-        if use_flash and mesh is not None:
+        return cache
+
+    def _prefill_stacked(self, use_flash, pad_lens, layer_window,
+                         q_offset: int = 0):
+        """Flash/sharded-flash stacked-attention fn for a prefill-style
+        forward whose queries start at cache slot ``q_offset`` (0 = whole
+        prompt; chunked prefill passes each chunk's start). None when the
+        dense path is in effect."""
+        cfg = self.cfg
+        mesh = self.mesh
+        interpret = self.interpret
+        if not use_flash:
+            return None
+        if mesh is not None:
             from ..ops.sharded import sharded_flash_prefill
 
             def stacked_fn(q, cache, layer_idx):
                 return sharded_flash_prefill(
                     mesh, q, cache, layer_idx, pad_lens, cfg.q_per_kv,
-                    layer_window(layer_idx), interpret=interpret,
+                    layer_window(layer_idx), q_offset, interpret=interpret,
                 )
-        elif use_flash:
+        else:
             from ..ops.flash_attention import flash_prefill_attention
 
             def stacked_fn(q, cache, layer_idx):
                 return flash_prefill_attention(
                     q, cache, layer_idx, pad_lens, cfg.q_per_kv,
-                    layer_window(layer_idx), interpret=interpret,
+                    layer_window(layer_idx), q_offset, interpret=interpret,
                 )
 
-        return cache, stacked_fn
+        return stacked_fn
+
+    def _prefill_forward(self, params, tokens, pad_lens, B, S, C,
+                         use_flash, layer_window):
+        """Whole- or chunked-prompt prefill into a fresh cache; returns
+        (last-position logits, cache). ONE copy shared by prefill_part
+        (_make_parts) and the choice scorer (_make_choice_fn), so the two
+        paths cannot drift AND the chunked path's memory headroom applies
+        to both. Called inside traced functions — pad_lens is a tracer;
+        chunk boundaries are trace-static."""
+        cfg = self.cfg
+        cache = self._init_prefill_cache(B, C)
+        positions = prefill_positions(pad_lens, S)
+        mask = prefill_attention_mask(pad_lens, S, C)
+        CL = self.prefill_chunk_tokens
+        n_chunks = -(-S // CL) if CL and S > CL else 1
+        if n_chunks == 1:
+            return forward(
+                params, cfg, tokens, positions, cache, 0, mask,
+                last_only=True,
+                stacked_attention_fn=self._prefill_stacked(
+                    use_flash, pad_lens, layer_window
+                ),
+            )
+        # chunked: transient activations scale with the CHUNK length, not
+        # the full S — the kernel's q_offset places chunk c's queries at
+        # cache slots [lo, hi) (see prefill_part's rationale comment)
+        for c in range(n_chunks):
+            lo, hi = c * CL, min(S, (c + 1) * CL)
+            logits, cache = forward(
+                params, cfg, tokens[:, lo:hi], positions[:, lo:hi],
+                cache, lo, mask[:, lo:hi, :],
+                last_only=(c == n_chunks - 1),
+                stacked_attention_fn=self._prefill_stacked(
+                    use_flash, pad_lens, layer_window, q_offset=lo
+                ),
+            )
+        return logits, cache
 
     # -- constrained choice scoring --------------------------------------
 
@@ -507,21 +557,14 @@ class TpuBackend:
         203-433) trusts a remote LLM to emit parseable JSON and contains
         per-case failures; containment still exists here, but constrained
         choice makes success the typical case instead of the lucky one."""
-        cfg = self.cfg
         C = S  # no decode budget — the cache only satisfies forward()
         use_flash, _ = self._decode_settings(S, C)
         mesh = self.mesh
         layer_window = self._layer_window_fn()
 
         def choose(params, tokens, pad_lens, choice_ids):
-            cache, stacked_fn = self._prefill_setup(
-                B, C, use_flash, pad_lens, layer_window
-            )
-            positions = prefill_positions(pad_lens, S)
-            mask = prefill_attention_mask(pad_lens, S, C)
-            logits, _ = forward(
-                params, cfg, tokens, positions, cache, 0, mask,
-                last_only=True, stacked_attention_fn=stacked_fn,
+            logits, _ = self._prefill_forward(
+                params, tokens, pad_lens, B, S, C, use_flash, layer_window
             )
             row = logits[:, -1, :]                       # [B, V] float32
             picked = jnp.take(row, choice_ids, axis=-1)  # [B, K]

@@ -40,6 +40,7 @@ def _kernel(
     lidx_ref,  # [1] int32 (scalar prefetch, SMEM) — layer to read
     pad_ref,   # [B] int32 (scalar prefetch, SMEM)
     win_ref,   # [1] int32 (scalar prefetch, SMEM) — sliding window; 0 = global
+    off_ref,   # [1] int32 (scalar prefetch, SMEM) — cache slot of query 0
     *refs,
     block_q: int,
     block_k: int,
@@ -64,7 +65,9 @@ def _kernel(
     j = pl.program_id(3)
     nj = pl.num_programs(3)
 
-    q_start = i * block_q
+    # chunked prefill: queries live at cache slots off..off+S-1 (chunk c of
+    # a longer prompt); off = 0 is the classic whole-prompt prefill
+    q_start = off_ref[0] + i * block_q
     k_start = j * block_k
     win = win_ref[0]
 
@@ -99,12 +102,15 @@ def _kernel(
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         pad = pad_ref[b]
         # k_pos <= q_pos also kills the masked tail of a partial K block
-        # (those slots have k_pos >= seq_len > any valid q_pos); q_pos of a
-        # partial Q-block tail produces garbage rows the caller never reads.
+        # (those slots have k_pos > any valid q_pos); q_pos of a partial
+        # Q-block tail produces garbage rows the caller never reads.
         # Window semantics in SLOT space match the dense path
         # (models.llama._block: k_slot > q_slot - window) — left pad shifts
         # q and k slots identically, so the token-space window is preserved
-        mask = (k_pos <= q_pos) & (k_pos >= pad) & (q_pos < seq_len)
+        mask = (
+            (k_pos <= q_pos) & (k_pos >= pad)
+            & (q_pos < off_ref[0] + seq_len)
+        )
         mask = mask & ((win == 0) | (k_pos > q_pos - win))
         s = jnp.where(mask, s, _NEG)
 
@@ -148,6 +154,7 @@ def flash_prefill_attention(
     pad_lens: jax.Array,   # [B] int32 — left-pad per sequence
     q_per_kv: int,
     window: jax.Array | None = None,  # scalar int32; 0/None = global
+    q_offset: jax.Array | None = None,  # scalar int32; cache slot of query 0
     *,
     block_q: int = 512,
     block_k: int = 512,
@@ -158,6 +165,10 @@ def flash_prefill_attention(
     ``layer_idx``. ``window`` > 0 additionally restricts each query to the
     last ``window`` slots (Gemma sliding layers — the per-layer value is a
     runtime scalar, so one compiled program serves global and local layers).
+    ``q_offset`` places the S queries at cache slots
+    [q_offset, q_offset + S) — chunk c of a CHUNKED prefill (the engine's
+    prefill_chunk_tokens path, which halves/quarters prefill transients so
+    bigger decode batches fit); 0/None is the classic whole-prompt prefill.
 
     K/V blocks a query block can never see — strictly above the causal
     diagonal, or wholly below the window floor — are both compute-skipped
@@ -175,23 +186,27 @@ def flash_prefill_attention(
 
     qt = q.transpose(0, 2, 1, 3)   # [B, H, S, hd]
 
-    def visible_j(i, j, win):
-        j_hi = (i * bq + bq - 1) // bk  # causal: last block any row sees
+    def visible_j(i, j, win, off):
+        # causal: last block any row sees (rows start at off + i*bq)
+        j_hi = (off[0] + i * bq + bq - 1) // bk
         # window: first block any row sees — the FIRST query row's floor
         lo = jnp.where(
-            win[0] > 0, jnp.maximum(i * bq - win[0] + 1, 0) // bk, 0
+            win[0] > 0,
+            jnp.maximum(off[0] + i * bq - win[0] + 1, 0) // bk,
+            0,
         )
         return jnp.clip(j, lo, j_hi)
 
-    def kv_index(b, h, i, j, lidx, pad, win, g=q_per_kv):
-        return (lidx[0], b, h // g, visible_j(i, j, win), 0)
+    def kv_index(b, h, i, j, lidx, pad, win, off, g=q_per_kv):
+        return (lidx[0], b, h // g, visible_j(i, j, win, off), 0)
 
-    def scale_index(b, h, i, j, lidx, pad, win):
-        return (lidx[0], b, 0, visible_j(i, j, win))
+    def scale_index(b, h, i, j, lidx, pad, win, off):
+        return (lidx[0], b, 0, visible_j(i, j, win, off))
 
     in_specs = [
         pl.BlockSpec(
-            (1, 1, bq, hd), lambda b, h, i, j, lidx, pad, win: (b, h, i, 0)
+            (1, 1, bq, hd),
+            lambda b, h, i, j, lidx, pad, win, off: (b, h, i, 0),
         ),
         pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
         pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
@@ -212,12 +227,12 @@ def flash_prefill_attention(
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=4,
             grid=grid,
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, bq, hd),
-                lambda b, h, i, j, lidx, pad, win: (b, h, i, 0),
+                lambda b, h, i, j, lidx, pad, win, off: (b, h, i, 0),
             ),
             scratch_shapes=[
                 pltpu.VMEM((bq, hd), jnp.float32),
@@ -231,6 +246,7 @@ def flash_prefill_attention(
         jnp.asarray(layer_idx, jnp.int32).reshape(1),
         pad_lens.astype(jnp.int32),
         jnp.asarray(0 if window is None else window, jnp.int32).reshape(1),
+        jnp.asarray(0 if q_offset is None else q_offset, jnp.int32).reshape(1),
         *operands,
     )
     return out.transpose(0, 2, 1, 3)

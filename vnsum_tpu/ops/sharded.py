@@ -38,24 +38,27 @@ def sharded_flash_prefill(
     pad_lens,
     q_per_kv: int,
     window=None,
+    q_offset=None,
     *,
     interpret: bool = False,
 ):
     """flash_prefill_attention with q/cache sharded over (data, model).
-    ``window`` is a replicated scalar (0/None = global layer)."""
+    ``window`` and ``q_offset`` are replicated scalars (0/None = global
+    layer / whole-prompt prefill)."""
     import jax.numpy as jnp
 
     fn = shard_map(
-        lambda qs, cs, li, pads, win: flash_prefill_attention(
-            qs, cs, li, pads, q_per_kv, win, interpret=interpret
+        lambda qs, cs, li, pads, win, off: flash_prefill_attention(
+            qs, cs, li, pads, q_per_kv, win, off, interpret=interpret
         ),
         mesh=mesh,
-        in_specs=(_Q_SPEC, _cache_specs(cache), P(), P(AXES.data), P()),
+        in_specs=(_Q_SPEC, _cache_specs(cache), P(), P(AXES.data), P(), P()),
         out_specs=_Q_SPEC,
         check_vma=False,
     )
     win = jnp.asarray(0 if window is None else window, jnp.int32)
-    return fn(q, cache, layer_idx, pad_lens, win)
+    off = jnp.asarray(0 if q_offset is None else q_offset, jnp.int32)
+    return fn(q, cache, layer_idx, pad_lens, win, off)
 
 
 def sharded_flash_decode(
