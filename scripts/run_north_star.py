@@ -115,6 +115,15 @@ def main() -> int:
 
     approaches = args.approaches.split(",")
     per_approach: dict = {}
+    out_p = Path(args.out)
+    if out_p.exists():
+        # partial rerun (e.g. refreshing only the mapreduce arm after an
+        # engine-default change): keep previously measured approaches,
+        # tagged with the config they ran under
+        prev = json.loads(out_p.read_text()).get("approaches", {})
+        for k, v in prev.items():
+            if k not in approaches:
+                per_approach[k] = v
     for approach in approaches:
         full_eval = approach == "mapreduce"  # the headline gets the full
         # eval chain; the other four run their summarize phase (VERDICT
