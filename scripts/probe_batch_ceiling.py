@@ -1,0 +1,157 @@
+"""Batch ceiling probe: is there decode amortization left past B=16?
+
+PERF.md finding 24 landed B=16 via chunked prefill (decode 1.36x per-row
+vs B=8 — 3.2 GB of weight reads amortize over twice the rows). The weight
+term keeps shrinking with B until the int8 KV cache (477 MB/row at
+C=8320) hits the 16 GB HBM wall: B=20 needs ~12.7 GB resident, B=24
+~14.6 GB. This probe measures ONE dispatch at each candidate B (chunked
+prefill keeps transients at a chunk's worth) and compares PER-ROW wall —
+prefill should stay flat per row, decode should keep dropping until OOM.
+
+OOM is a recorded outcome, not an error. Writes
+artifacts/batch_ceiling.json.
+"""
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def run_arm(label: str, tok_spec, prompts, batch: int, chunk: int,
+            gen_cfg) -> dict:
+    import bench
+    from vnsum_tpu.backend.engine import EngineStats, TpuBackend
+
+    kw = bench.e2e_engine_kwargs(tok_spec, None)
+    kw.update(batch_size=batch, prefill_chunk_tokens=chunk)
+    try:
+        be = TpuBackend(**kw, instrument=True)
+        t0 = time.time()
+        be.generate(prompts[:batch], config=gen_cfg)
+        compile_s = time.time() - t0
+        be.stats = EngineStats()
+        t1 = time.time()
+        be.generate(prompts[:batch], config=gen_cfg)
+        wall = time.time() - t1
+        st = be.stats
+        steps = sum(d["steps"] for d in st.dispatches)
+        row = {
+            "label": label, "B": batch, "chunk": chunk,
+            "compile_and_warm_s": round(compile_s, 1),
+            "wall_s": round(wall, 2),
+            "wall_s_per_row": round(wall / batch, 4),
+            "prefill_s": round(st.phase_seconds.get("prefill", 0.0), 2),
+            "prefill_s_per_row": round(
+                st.phase_seconds.get("prefill", 0.0) / batch, 4),
+            "decode_s": round(st.phase_seconds.get("decode", 0.0), 3),
+            "decode_ms_per_step": round(
+                1e3 * st.phase_seconds.get("decode", 0.0) / max(steps, 1), 2),
+            "decode_ms_per_step_row": round(
+                1e3 * st.phase_seconds.get("decode", 0.0)
+                / max(steps, 1) / batch, 3),
+            "decode_steps": steps,
+            "dispatches": st.dispatches,
+        }
+        try:
+            # NOTE peak_bytes_in_use is the PROCESS-lifetime allocator peak,
+            # so later arms inherit earlier arms' peak — fit/no-fit (OOM) is
+            # the per-arm memory signal; bytes_in_use is current-resident
+            import jax
+
+            ms = jax.local_devices()[0].memory_stats() or {}
+            for k in ("bytes_in_use", "peak_bytes_in_use"):
+                if k in ms:
+                    row[k] = int(ms[k])
+        except Exception:
+            pass
+        del be
+        gc.collect()
+        print(f"{label}: {json.dumps(row)[:360]}", file=sys.stderr)
+        return row
+    except Exception as e:
+        gc.collect()
+        row = {"label": label, "B": batch, "chunk": chunk,
+               "status": "failed", "error": str(e)[:300]}
+        print(f"{label} FAILED: {str(e)[:200]}", file=sys.stderr)
+        return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/batch_ceiling.json")
+    ap.add_argument("--max-new", type=int, default=128)
+    ap.add_argument("--batches", default="16,20,24")
+    ap.add_argument("--chunk", type=int, default=0,
+                    help="override prefill chunk for every arm (0 = auto)")
+    args = ap.parse_args()
+
+    from vnsum_tpu.core.config import GenerationConfig
+    from vnsum_tpu.core.jax_cache import enable_compilation_cache
+    from vnsum_tpu.data.synthesize import synthesize_corpus
+    from vnsum_tpu.models.fixtures import train_bpe_tokenizer
+
+    enable_compilation_cache()
+    root = tempfile.mkdtemp(prefix="vnsum_bceil_")
+    synthesize_corpus(
+        f"{root}/corpus", n_docs=4, tokens_per_doc=9_000,
+        summary_tokens=200, seed=7, ragged=0.0,
+    )
+    doc_paths = sorted(Path(f"{root}/corpus/doc").glob("*.txt"))
+    hf_tok = train_bpe_tokenizer(
+        (p.read_text(encoding="utf-8") for p in doc_paths), vocab_size=4096
+    )
+    hf_tok.save_pretrained(f"{root}/tok")
+    tok_spec = f"hf:{root}/tok"
+
+    words = " ".join(p.read_text(encoding="utf-8") for p in doc_paths).split()
+    batches = [int(b) for b in args.batches.split(",")]
+    n_prompts = max(batches)
+    prompts = []
+    for i in range(n_prompts):
+        seg = " ".join(words[(i * 1500) % 20000 : (i * 1500) % 20000 + 7400])
+        prompts.append(f"Tóm tắt văn bản số {i}: " + seg)
+
+    gen_cfg = GenerationConfig(
+        max_new_tokens=args.max_new, temperature=1.0, seed=11
+    )
+    rows = []
+    for b in batches:
+        # chunk 2048 is the production default; drop to 1024 at B>=24 to
+        # keep prefill transients inside the shrinking headroom
+        chunk = args.chunk or (2048 if b < 24 else 1024)
+        rows.append(run_arm(f"b{b}_chunk{chunk}", tok_spec, prompts, b,
+                            chunk, gen_cfg))
+        if rows[-1].get("status") == "failed":
+            break  # bigger B only gets worse
+
+    ok = [r for r in rows if r.get("status") != "failed"]
+    if ok:
+        base = ok[0]["wall_s_per_row"]
+        for r in ok:
+            r["per_row_speedup_vs_first"] = round(base / r["wall_s_per_row"], 3)
+    rec = {
+        "what": "single-dispatch per-row wall at growing B (e2e config, "
+                "chunked prefill); OOM marks the HBM ceiling",
+        "arms": rows,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(rec, indent=2))
+    print(json.dumps({"ok": True, "arms": {
+        r["label"]: r.get("per_row_speedup_vs_first") or r.get("status")
+        for r in rows
+    }}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,6 +40,10 @@ def main() -> int:
         default="mapreduce,truncated,iterative,mapreduce_hierarchical,"
                 "mapreduce_critique",
     )
+    ap.add_argument("--engine-batch", type=int, default=0,
+                    help="override e2e engine batch_size (0 = default)")
+    ap.add_argument("--engine-chunk", type=int, default=-1,
+                    help="override prefill_chunk_tokens (-1 = default)")
     args = ap.parse_args()
 
     import bench
@@ -90,7 +94,15 @@ def main() -> int:
         "bytes_per_token": round(bytes_per_tok, 2),
     }
 
-    backend = TpuBackend(**bench.e2e_engine_kwargs(tok_spec, None))
+    ekw = bench.e2e_engine_kwargs(tok_spec, None)
+    if args.engine_batch:
+        ekw["batch_size"] = args.engine_batch
+    if args.engine_chunk >= 0:
+        ekw["prefill_chunk_tokens"] = args.engine_chunk
+    rec["engine_overrides"] = {
+        k: ekw[k] for k in ("batch_size", "prefill_chunk_tokens")
+    }
+    backend = TpuBackend(**ekw)
 
     # ragged-EOS probe (bench.py's procedure): sampled decode over a
     # random-init model needs a declared EOS that fires at scattered depths

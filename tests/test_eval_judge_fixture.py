@@ -1,0 +1,100 @@
+"""Judge-fixture curriculum tests (vnsum_tpu/eval/judge_fixture.py).
+
+The trained device judge (scripts/make_trained_judge_artifact.py) rests on
+two invariants testable without training: the curriculum supervises the
+EXACT token position ``TpuBackend.score_choices`` queries, and the
+corruption machinery grades cleanly. A tiny training smoke (slow tier)
+checks the loop runs end to end and saves a loadable HF checkpoint.
+"""
+import pytest
+
+from vnsum_tpu.eval.geval import LLMJudge
+from vnsum_tpu.eval.judge_fixture import (
+    CONTENT_WORDS,
+    LEVELS,
+    NOISE_WORDS,
+    build_cases,
+    corrupt,
+    curriculum_corpus,
+    level_digit,
+    make_summary,
+)
+
+
+def test_level_digit_mapping():
+    assert [level_digit(p) for p in LEVELS] == [5, 4, 3, 2, 1]
+
+
+def test_lexicons_disjoint():
+    assert not set(CONTENT_WORDS) & set(NOISE_WORDS)
+
+
+def test_corrupt_replaces_expected_fraction():
+    import random
+
+    rng = random.Random(0)
+    s = make_summary(rng, sentences=5, words_per_sentence=10)
+    n = len(s.split())
+    for p in (0.0, 0.5, 1.0):
+        bad = sum(
+            w in NOISE_WORDS for w in corrupt(random.Random(1), s, p).split()
+        )
+        assert abs(bad - p * n) <= 1
+
+
+def test_cases_balanced_and_use_production_template():
+    cases = build_cases(3, seed=0)
+    # per level: 3 correctness + 3 coherence
+    assert len(cases) == len(LEVELS) * 6
+    for c in cases:
+        # the forced prefix must terminate every prompt — score_choices
+        # appends the digit right after it
+        assert c.prompt.endswith(LLMJudge._FORCED_PREFIX)
+        assert "expert evaluator of text summaries" in c.prompt
+        if c.kind == "correctness":
+            assert "Reference summary:" in c.prompt
+        else:
+            assert "Reference summary:" not in c.prompt
+    digits = {c.digit for c in cases}
+    assert digits == {1, 2, 3, 4, 5}
+
+
+def test_clean_correctness_case_is_verbatim_faithful():
+    for c in build_cases(2, seed=3):
+        if c.level == 0.0 and c.kind == "correctness":
+            gen = c.prompt.split("Generated summary:\n")[1].split(
+                "\n\nReference summary:\n"
+            )[0]
+            ref = c.prompt.split("\n\nReference summary:\n")[1].split("\n")[0]
+            assert gen == ref
+
+
+def test_curriculum_corpus_teaches_digit_merges():
+    texts = curriculum_corpus(build_cases(2, seed=0))
+    joined = " ".join(texts)
+    for d in "12345":
+        assert f'{{"score": {d}' in joined
+
+
+@pytest.mark.slow
+def test_training_smoke_saves_loadable_checkpoint(tmp_path):
+    import torch
+
+    from vnsum_tpu.eval.judge_fixture import train_judge_fixture
+
+    model, tok, digit_ids = train_judge_fixture(
+        tmp_path / "judge", n_per_level=2, steps=3, vocab_size=384
+    )
+    assert len(set(digit_ids)) == 5
+    # the saved checkpoint loads through the production converter path
+    from vnsum_tpu.models.convert import load_hf_checkpoint
+
+    cfg, params = load_hf_checkpoint(str(tmp_path / "judge"))
+    assert cfg.vocab_size == len(tok)
+    # supervised position == score_choices' query position: the first token
+    # of a digit choice scores next after [bos] + encode(prompt)
+    c = build_cases(1, seed=9)[0]
+    ids = [tok.bos_token_id] + tok.encode(c.prompt)
+    with torch.no_grad():
+        logits = model(input_ids=torch.tensor([ids])).logits[0, -1]
+    assert logits.shape[-1] == cfg.vocab_size
