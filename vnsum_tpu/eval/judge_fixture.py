@@ -119,10 +119,10 @@ def curriculum_corpus(cases: list[JudgeCase]) -> list[str]:
 def train_judge_fixture(
     out_dir,
     n_per_level: int = 24,
-    steps: int = 600,
+    steps: int = 800,
     seed: int = 0,
     vocab_size: int = 512,
-    lr: float = 3e-3,
+    lr: float = 2e-3,
     progress=None,
 ):
     """Train the tiny llama-family judge on the curriculum and
