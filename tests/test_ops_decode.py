@@ -246,3 +246,39 @@ def test_decode_partials_fully_masked_rows_are_inert():
     )
     assert np.asarray(l)[1].max() == 0.0
     assert np.asarray(l)[0].min() > 0.0
+
+
+def test_prefill_kernel_int8_cache_bf16_queries_close_to_f32():
+    """The PRODUCTION prefill configuration — bf16 queries against the int8
+    quantized cache — must track the f32-query/dequantized-dense oracle to
+    bf16 rounding. Guards the quantized+bf16 interaction specifically: the
+    in-kernel order is (scores x ks) and (p x vs) in f32 BEFORE p drops to
+    bf16 for the PV dot; applying vs after the cast, or casting the f32
+    scales themselves, would pass the f32-only parity tests but corrupt
+    this path (code-review finding, round 5)."""
+    from vnsum_tpu.models.llama import (
+        dequantize_cache_layer,
+        prefill_attention_mask,
+    )
+    from vnsum_tpu.ops.flash_attention import flash_prefill_attention
+
+    L, B, S, C, KV, H, hd = 2, 2, 32, 48, 2, 4, 128
+    q = jax.random.normal(jax.random.key(33), (B, S, H, hd), jnp.float32)
+    _, cache = make_case(L, B, KV, C, H, hd, seed=33)
+    qcache = quantize_case(cache)
+    pad = jnp.asarray([0, 5], jnp.int32)
+
+    kd, vd = dequantize_cache_layer(qcache, 1)
+    mask = prefill_attention_mask(pad, S, C)
+    oracle = _attention(q, kd, vd, mask, H // KV)
+    flash_bf16 = flash_prefill_attention(
+        q.astype(jnp.bfloat16), qcache, 1, pad, H // KV,
+        block_q=16, block_k=16, interpret=True,
+    )
+    assert flash_bf16.dtype == jnp.bfloat16
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(oracle, np.float32)[b, int(pad[b]):],
+            np.asarray(flash_bf16, np.float32)[b, int(pad[b]):],
+            rtol=0.05, atol=0.05,
+        )

@@ -194,3 +194,28 @@ def test_flash_q_offset_with_window():
         np.asarray(full)[0, lo:hi], np.asarray(chunk)[0],
         rtol=2e-5, atol=2e-5,
     )
+
+
+def test_flash_bf16_compute_dtype_close_to_f32():
+    """The kernel computes its dots in the QUERY dtype (f32 tests exact;
+    the engine's bf16 gets the MXU full-rate path — the f32 in-kernel dots
+    previously made attention 39% of prefill device time for ~10% of its
+    FLOPs, artifacts/prefill_gap.json). bf16 inputs must stay within bf16
+    rounding of the f32 oracle: f32 accumulation bounds the error at the
+    input-rounding level (~1e-2), not O(sqrt(K)) growth."""
+    L, B, S, C, H, KV, hd = 2, 2, 32, 64, 4, 2, 128
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=5)
+    pad = jnp.asarray([0, 3], jnp.int32)
+    oracle = flash_prefill_attention(q, cache, 1, pad, H // KV, interpret=True)
+    bf = flash_prefill_attention(
+        q.astype(jnp.bfloat16),
+        {k: v.astype(jnp.bfloat16) for k, v in cache.items()},
+        1, pad, H // KV, interpret=True,
+    )
+    assert bf.dtype == jnp.bfloat16
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(oracle, np.float32)[b, int(pad[b]):],
+            np.asarray(bf, np.float32)[b, int(pad[b]):],
+            rtol=0.05, atol=0.05,
+        )

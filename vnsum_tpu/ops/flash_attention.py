@@ -8,10 +8,16 @@ VMEM holds only (BQ × BK) score tiles and HBM never sees a score tensor:
 
 - grid (B, H, ⌈S/BQ⌉, ⌈C/BK⌉), K-block innermost; scratch (acc, m, l)
   carries the running softmax across K blocks; output written on the last;
-- **ceil-division grids with masked tails**: block sizes stay at MXU-friendly
-  512 for ANY S/C. An earlier divisor-only picker collapsed to 32-wide
+- **ceil-division grids with masked tails**: block sizes stay MXU-friendly
+  for ANY S/C. An earlier divisor-only picker collapsed to 32-wide
   K blocks at C=2080 (8 KB DMAs) and the kernel ran 60% of total profile
   time — tail masking costs one wasted partial block instead;
+- **1024-wide blocks, measured**: this kernel is DMA-granularity-bound,
+  not MXU-bound (switching the dots bf16 moved nothing —
+  artifacts/prefill_gap.json); 1024x1024 blocks beat the original 512x512
+  by 1.61x at the e2e chunk shape and 1.26x at the map shape
+  (artifacts/flash_block_geometry.json). 2048-wide blocks fail to compile
+  (VMEM), bk=2048 at bq=512 is no better than bk=1024;
 - **consumes the FULL stacked cache [L, B, KV, C, hd]** like the decode twin
   (ops/decode_attention.py): the layer index arrives via scalar prefetch and
   steers the index_map, eliminating the per-layer 2×(B·C·hd·KV) extraction
@@ -87,14 +93,23 @@ def _kernel(
         & ((win == 0) | (k_start + block_k - 1 >= q_start - win + 1))
     )
     def _compute():
-        qb = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0, 0].astype(jnp.float32)
+        # MXU inputs stay in the QUERY dtype with f32 accumulation
+        # (preferred_element_type): f32 parity tests keep exact f32 dots,
+        # the engine's bf16 takes the native-rate MXU path. Measured
+        # NEUTRAL on wall (the kernel is DMA-granularity-bound, not
+        # compute-bound — the 1024-wide blocks are the actual win, see
+        # module header + artifacts/flash_block_geometry.json); kept
+        # because f32 dots waste MXU throughput headroom for nothing the
+        # f32 oracle tests need. int8 cache values (-128..127) are exactly
+        # representable in bf16, so the dequant algebra is unchanged.
+        qb = q_ref[0, 0]
+        kb = k_ref[0, 0, 0].astype(qb.dtype)
+        vb = v_ref[0, 0, 0].astype(qb.dtype)
 
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [BQ, BK]
+        ) * scale  # [BQ, BK] f32
         if quantized:
             s = s * ks_ref[0, 0, h // q_per_kv][None, :]
 
@@ -124,8 +139,11 @@ def _kernel(
         l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         if quantized:
             p = p * vs_ref[0, 0, h // q_per_kv][None, :]
+        # probabilities drop to the query dtype for the PV dot (bf16 adds
+        # ~0.4% relative rounding — same class as the int8 V scale already
+        # applied above); accumulation stays f32 in acc_ref
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+            p.astype(qb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -156,8 +174,8 @@ def flash_prefill_attention(
     window: jax.Array | None = None,  # scalar int32; 0/None = global
     q_offset: jax.Array | None = None,  # scalar int32; cache slot of query 0
     *,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: bool = False,
 ) -> jax.Array:
     """Returns [B, S, H, hd]; semantics match _attention with the prefill
