@@ -132,10 +132,9 @@ def main() -> int:
         )
     )
     proj_flops = 2 * B * S * params
+    # QK^T and PV are 2*B*H*S*S*hd FLOPs EACH (mult+add); causal halves
+    # the S^2 → per-layer total 2*B*H*S^2*hd
     attn_flops = cfg.n_layers * 2 * B * cfg.n_heads * S * S * cfg.head_dim
-    # (QK^T + PV, causal halves S^2 but online-softmax bookkeeping and the
-    # band tail roughly cancel the half for a bound; keep the causal half)
-    attn_flops = attn_flops // 2
     analytic = {
         "B": B, "S": S,
         "proj_flops": proj_flops,

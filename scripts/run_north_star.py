@@ -44,6 +44,11 @@ def main() -> int:
                     help="override e2e engine batch_size (0 = default)")
     ap.add_argument("--engine-chunk", type=int, default=-1,
                     help="override prefill_chunk_tokens (-1 = default)")
+    ap.add_argument("--doc-group", type=int, default=32,
+                    help="pipeline doc_group_size (32 measured best: one "
+                         "giant group REGRESSES ~1.6x — see north-star "
+                         "config_note; -1 = all docs in one group, 0 = "
+                         "library default of 4x batch)")
     args = ap.parse_args()
 
     import bench
@@ -155,7 +160,15 @@ def main() -> int:
             iterative_chunk_overlap=200,
             token_max=6_000,
             max_new_tokens=128,
-            batch_size=8,
+            # keep the pipeline's grouping in sync with the ENGINE batch:
+            # batch_size=8 here left doc groups at 32 while the engine
+            # dispatched 16-row batches — half-filled collapse rounds and a
+            # 23-doc tail group at 2x the per-doc cost (run log,
+            # pipeline_run_20260731_125629). One group = maximal dispatch
+            # fill for the fixed 151-doc artifact workload.
+            batch_size=ekw["batch_size"],
+            doc_group_size=(args.docs if args.doc_group == -1
+                            else args.doc_group),
             tokenizer=tok_spec,
             tree_json_path=f"{root}/corpus/document_tree.json",
         )
@@ -205,6 +218,16 @@ def main() -> int:
         row["compile_seconds_in_phase"] = round(
             backend.stats.compile_seconds - compile_before, 1
         )
+        # engine-level attribution: bucket mix + host/device phase seconds
+        # (who ate the wall — dispatches, tokenize, or strategy host code)
+        st = backend.stats
+        row["engine_stats"] = {
+            "by_bucket": {f"B{b}xS{s}": n for (b, s), n in
+                          sorted(st.by_bucket.items())},
+            "phase_seconds": {k: round(v, 1) for k, v in
+                              sorted(st.phase_seconds.items())},
+            "generate_seconds": round(st.generate_seconds, 1),
+        }
         if row["docs_ok"] == 0:
             raise RuntimeError(f"{approach}: all documents failed")
         per_approach[approach] = row

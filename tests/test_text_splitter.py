@@ -77,3 +77,31 @@ def test_token_length_function():
     )
     for c in sp.split_text(text):
         assert tok.count(c) <= 64
+
+
+def test_batch_length_function_is_equivalent():
+    """length_batch_function must produce IDENTICAL chunks to the scalar
+    length function — it exists purely to collapse thousands of per-piece
+    tokenizer calls into one call per split level."""
+    text = ("Việt Nam phát triển kinh tế. Xã hội bền vững! Văn hóa đa dạng; "
+            "giáo dục hiện đại?\n\nĐoạn mới với nhiều câu. " * 40)
+    calls = {"batch": 0, "scalar": 0}
+
+    def scalar(t):
+        calls["scalar"] += 1
+        return len(t.split())
+
+    def batch(ts):
+        calls["batch"] += 1
+        return [len(t.split()) for t in ts]
+
+    from vnsum_tpu.text.splitter import RecursiveTokenSplitter
+
+    base = RecursiveTokenSplitter(40, 8, length_function=scalar)
+    fast = RecursiveTokenSplitter(
+        40, 8, length_function=scalar, length_batch_function=batch
+    )
+    a = base.split_text(text)
+    b = fast.split_text(text)
+    assert a == b
+    assert calls["batch"] > 0

@@ -34,3 +34,35 @@ def test_factory():
         raise AssertionError("expected ValueError")
     except ValueError:
         pass
+
+
+def test_encode_batch_matches_encode():
+    import pytest
+
+    from vnsum_tpu.text.tokenizer import ByteTokenizer
+
+    bt = ByteTokenizer()
+    texts = ["xin chào", "tóm tắt văn bản", ""]
+    assert bt.encode_batch(texts) == [bt.encode(t) for t in texts]
+    assert bt.encode_batch(texts, add_bos=True) == [
+        bt.encode(t, add_bos=True) for t in texts
+    ]
+    assert bt.count_batch(texts) == [bt.count(t) for t in texts]
+
+    # HF fast tokenizer: batch call must agree with per-text calls
+    tokenizers = pytest.importorskip("tokenizers")  # noqa: F841
+    from vnsum_tpu.models.fixtures import train_bpe_tokenizer
+    from vnsum_tpu.text.tokenizer import HFTokenizer
+    import tempfile
+
+    hf = train_bpe_tokenizer(["xin chào việt nam tóm tắt văn bản"] * 4,
+                             vocab_size=384)
+    d = tempfile.mkdtemp()
+    hf.save_pretrained(d)
+    tok = HFTokenizer(d)
+    texts = ["xin chào", "tóm tắt văn bản dài hơn một chút", ""]
+    assert tok.encode_batch(texts) == [tok.encode(t) for t in texts]
+    assert tok.encode_batch(texts, add_bos=True) == [
+        tok.encode(t, add_bos=True) for t in texts
+    ]
+    assert tok.count_batch(texts) == [tok.count(t) for t in texts]

@@ -31,6 +31,11 @@ class Backend(Protocol):
     def count_tokens(self, text: str) -> int:
         ...
 
+    def count_tokens_batch(self, texts: list[str]) -> list[int]:
+        """Batched count — the splitter issues one call per split
+        level instead of one per sentence piece."""
+        ...
+
 
 # -- shared device-batch helpers (TpuBackend + LongContextBackend) ----------
 # Greedy parity between the one-chip engine and the seq-sharded long-context

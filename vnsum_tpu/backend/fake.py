@@ -56,3 +56,6 @@ class FakeBackend:
 
     def count_tokens(self, text: str) -> int:
         return whitespace_token_count(text)
+
+    def count_tokens_batch(self, texts: list[str]) -> list[int]:
+        return [whitespace_token_count(t) for t in texts]

@@ -664,3 +664,6 @@ class LongContextBackend:
 
     def count_tokens(self, text: str) -> int:
         return self.tok.count(text)
+
+    def count_tokens_batch(self, texts: list[str]) -> list[int]:
+        return self.tok.count_batch(texts)

@@ -145,3 +145,6 @@ class OllamaBackend:
         """Whitespace estimate, matching OllamaLLM.get_num_tokens
         (...mapreduce.py:58-60) for collapse-gating parity."""
         return whitespace_token_count(text)
+
+    def count_tokens_batch(self, texts: list[str]) -> list[int]:
+        return [whitespace_token_count(t) for t in texts]

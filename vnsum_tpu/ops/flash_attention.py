@@ -174,8 +174,8 @@ def flash_prefill_attention(
     window: jax.Array | None = None,  # scalar int32; 0/None = global
     q_offset: jax.Array | None = None,  # scalar int32; cache slot of query 0
     *,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Returns [B, S, H, hd]; semantics match _attention with the prefill
@@ -199,8 +199,14 @@ def flash_prefill_attention(
     L, _, KV, C, _ = k_all.shape
     if hd % _LANES and not interpret:
         raise ValueError(f"unsupported flash head_dim={hd}")
-    bq = min(block_q, S)
-    bk = min(block_k, C)
+    # default blocks scale inversely with head_dim so the per-step VMEM
+    # footprint stays at the measured-good hd=128 level: 1024x1024 tiles
+    # at hd=256 (Gemma3) would match the 2048-block geometry that fails
+    # to compile (VMEM) — hd=256 resolves to the 512 blocks the full
+    # 34-layer gemma3-4b is measured with (artifacts/multimodel_sweep.json)
+    default_block = max(512, 1024 * _LANES // max(hd, 1))
+    bq = min(block_q or default_block, S)
+    bk = min(block_k or default_block, C)
 
     qt = q.transpose(0, 2, 1, 3)   # [B, H, S, hd]
 

@@ -62,6 +62,7 @@ class MapReduceCritiqueStrategy:
         splitter = RecursiveTokenSplitter(
             config.chunk_size, config.chunk_overlap,
             length_function=backend.count_tokens,
+            length_batch_function=backend.count_tokens_batch,
         )
         return cls(
             backend, splitter, token_max=config.token_max,

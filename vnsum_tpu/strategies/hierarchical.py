@@ -46,7 +46,9 @@ class HierarchicalStrategy:
         self.max_depth = max_depth
         self.max_new_tokens = max_new_tokens
         self.splitter = RecursiveTokenSplitter(
-            self.chunk_size, chunk_overlap, length_function=backend.count_tokens
+            self.chunk_size, chunk_overlap,
+            length_function=backend.count_tokens,
+            length_batch_function=backend.count_tokens_batch,
         )
 
     @classmethod

@@ -34,6 +34,7 @@ class IterativeStrategy:
             config.iterative_chunk_size,
             config.iterative_chunk_overlap,
             length_function=backend.count_tokens,
+            length_batch_function=backend.count_tokens_batch,
         )
         return cls(backend, splitter, max_new_tokens=config.max_new_tokens, **kw)
 

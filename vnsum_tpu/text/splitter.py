@@ -31,12 +31,20 @@ class RecursiveTokenSplitter:
         chunk_overlap: int = 0,
         length_function: Callable[[str], int] = len,
         separators: Sequence[str] = VIETNAMESE_SEPARATORS,
+        length_batch_function: Callable[[Sequence[str]], list[int]] | None = None,
     ) -> None:
         if chunk_overlap >= chunk_size:
             raise ValueError("chunk_overlap must be smaller than chunk_size")
         self.chunk_size = chunk_size
         self.chunk_overlap = chunk_overlap
         self.length_function = length_function
+        # one tokenizer call per split level instead of one per PIECE: a
+        # reference-scale doc splits into thousands of sentence pieces, and
+        # per-piece HF encode calls dominated the pipeline's host time.
+        # Semantics are identical — batch(l) must equal [length(p) for p]
+        self.length_batch = length_batch_function or (
+            lambda texts: [length_function(t) for t in texts]
+        )
         self.separators = list(separators)
 
     # -- public API --------------------------------------------------------
@@ -76,28 +84,32 @@ class RecursiveTokenSplitter:
                 break
 
         splits = self._split_on(text, separator)
+        lens = self.length_batch(splits)  # counted ONCE per level
 
         chunks: list[str] = []
-        small: list[str] = []
-        for piece in splits:
-            if self.length_function(piece) < self.chunk_size:
-                small.append(piece)
+        small: list[tuple[str, int]] = []
+        for piece, plen in zip(splits, lens):
+            if plen < self.chunk_size:
+                small.append((piece, plen))
             else:
                 if small:
-                    chunks.extend(self._merge(small))
+                    chunks.extend(self._merge_counted(small))
                     small = []
                 if not next_separators:
                     chunks.append(piece)
                 else:
                     chunks.extend(self._split(piece, next_separators))
         if small:
-            chunks.extend(self._merge(small))
+            chunks.extend(self._merge_counted(small))
         return chunks
 
-    def _merge(self, pieces: list[str]) -> list[str]:
-        """Greedy merge of already-small pieces into ≤chunk_size chunks,
-        keeping a chunk_overlap-sized tail of pieces between chunks."""
-        lengths = [self.length_function(p) for p in pieces]
+    def _merge_counted(self, counted: list[tuple[str, int]]) -> list[str]:
+        """Greedy merge of already-small (piece, length) pairs into
+        ≤chunk_size chunks, keeping a chunk_overlap-sized tail of pieces
+        between chunks. Lengths arrive precomputed from the per-level
+        batch count in _split — never recounted here."""
+        pieces = [p for p, _ in counted]
+        lengths = [n for _, n in counted]
         chunks: list[str] = []
         window: list[str] = []
         window_lens: list[int] = []
