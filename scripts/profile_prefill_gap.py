@@ -145,6 +145,14 @@ def main() -> int:
             proj_flops / S8_MEASURED_CEILING + attn_flops / BF16_PEAK, 2),
         "s8_ceiling_tflops": S8_MEASURED_CEILING / 1e12,
         "bf16_peak_tflops": BF16_PEAK / 1e12,
+        "note": (
+            "optimistic_bound_s is a SANITY SCALE, not a bound: the "
+            "chained-matmul s8 microbench (132.7 TFLOP/s) underestimates "
+            "what the fused decoder achieves at this shape (~173 TFLOP/s "
+            "on the projection share — MFU 0.88 of bf16 peak per the "
+            "instrumented device budget), so measured dispatches can land "
+            "below it"
+        ),
     }
 
     ok = {r["label"]: r for r in rows if r.get("status") != "failed"}

@@ -231,6 +231,28 @@ def main() -> int:
         if row["docs_ok"] == 0:
             raise RuntimeError(f"{approach}: all documents failed")
         per_approach[approach] = row
+        if approach == "mapreduce":
+            # run-to-run history: the shared axon host's per-dispatch
+            # latency varies hour to hour (tokenize_host on identical
+            # code/data has measured 13.5-19.2 s), so single runs are
+            # samples — keep them all, headline reports the latest and
+            # best_measured the minimum
+            hist = rec.setdefault("mapreduce_run_history", [])
+            if out_p.exists():
+                prev_hist = json.loads(out_p.read_text()).get(
+                    "mapreduce_run_history", [])
+                for h in prev_hist:
+                    if h not in hist:
+                        hist.append(h)
+            hist.append({
+                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "wall_minutes": row["wall_minutes"],
+                "generate_seconds":
+                    row["engine_stats"]["generate_seconds"],
+                "tokenize_host_s":
+                    row["engine_stats"]["phase_seconds"].get(
+                        "tokenize_host"),
+            })
         print(f"{approach}: {json.dumps(row)}", file=sys.stderr)
         # checkpoint the artifact after every approach — a crash mid-run
         # must not lose measured phases (resume-by-file covers the rest)
@@ -240,7 +262,24 @@ def main() -> int:
         Path(args.out).write_text(json.dumps(rec, indent=2))
         gc.collect()
 
+    # script-owned provenance: a partial rerun must never drop the
+    # measurement conditions (a hand-added note was lost this way once)
+    rec["config_note"] = (
+        "measured under the round-5 FINAL stack: "
+        f"engine batch_size={ekw['batch_size']}, "
+        f"prefill_chunk_tokens={ekw.get('prefill_chunk_tokens')}, W8A8 "
+        f"(quantize_act={ekw.get('quantize_act')}), group-major flash "
+        "prefill kernel (bq=512/bk=2048 defaults at hd=128), batched host "
+        "tokenization (engine encode_batch + splitter per-level counts), "
+        f"doc_group_size={args.docs if args.doc_group == -1 else args.doc_group or '4x batch'}. "
+        "Doc-group sweep: one giant 151-doc group regresses mapreduce "
+        "~1.6x vs groups of 32 (recorded negative). Approaches absent "
+        "from --approaches keep their previously measured rows."
+    )
     mr = per_approach.get("mapreduce", {})
+    hist = rec.get("mapreduce_run_history", [])
+    if hist:
+        rec["best_measured"] = min(hist, key=lambda h: h["wall_minutes"])
     if mr:
         rec["headline"] = {
             "full_eval_minutes_one_chip": mr["wall_minutes"],

@@ -6,25 +6,36 @@ far below the reference's 12k-token chunks (SURVEY.md §5). This kernel
 computes attention blockwise with online-softmax scratch accumulators, so
 VMEM holds only (BQ × BK) score tiles and HBM never sees a score tensor:
 
-- grid (B, H, ⌈S/BQ⌉, ⌈C/BK⌉), K-block innermost; scratch (acc, m, l)
-  carries the running softmax across K blocks; output written on the last;
+- grid (B, KV, ⌈S/BQ⌉, ⌈C/BK⌉), K-block innermost; the whole GQA GROUP
+  (G = H/KV query heads) rides one grid cell — each K/V block is DMA'd
+  ONCE per group instead of once per query head (the original (B, H, …)
+  grid streamed every block G times; for Llama's 24:8 that was 3x the
+  mandatory attention bytes). The causal/pad/window mask is also computed
+  once per cell and shared by the G heads;
+- scratch (acc, m, l) carries the running softmax across K blocks per
+  head (static G-sliced rows of one scratch buffer — leading dims may
+  MERGE in-kernel but never split, so per-head slices beat a reshape);
+  output written on the last K block;
 - **ceil-division grids with masked tails**: block sizes stay MXU-friendly
   for ANY S/C. An earlier divisor-only picker collapsed to 32-wide
   K blocks at C=2080 (8 KB DMAs) and the kernel ran 60% of total profile
   time — tail masking costs one wasted partial block instead;
-- **1024-wide blocks, measured**: this kernel is DMA-granularity-bound,
-  not MXU-bound (switching the dots bf16 moved nothing —
-  artifacts/prefill_gap.json); 1024x1024 blocks beat the original 512x512
-  by 1.61x at the e2e chunk shape and 1.26x at the map shape
-  (artifacts/flash_block_geometry.json). 2048-wide blocks fail to compile
-  (VMEM), bk=2048 at bq=512 is no better than bk=1024;
+- **wide K blocks, measured**: this kernel is DMA-granularity-bound, not
+  MXU-bound (switching the dots bf16 moved nothing —
+  artifacts/prefill_gap.json). For the group-major grid the measured-best
+  default is bq=512 / bk=2048 at hd=128, G≤3 (30.8 ms/layer at the worst
+  e2e chunk vs the per-head kernel's best 37.5; map shape 19.4 vs 20.7 —
+  artifacts/flash_block_geometry.json holds the per-head history). bk
+  shrinks with head_dim (hd=256 Gemma3 → 1024) AND with G (the unrolled
+  per-head score temporaries stay live: G=4 at bk=2048 exceeds the 16 MB
+  scoped-VMEM budget, so G·bk is capped at 3·2048 — phi-4's 4:1 groups
+  resolve to bk=1024, measured working at 14.7 GB int8 on chip);
 - **consumes the FULL stacked cache [L, B, KV, C, hd]** like the decode twin
   (ops/decode_attention.py): the layer index arrives via scalar prefetch and
   steers the index_map, eliminating the per-layer 2×(B·C·hd·KV) extraction
   copies XLA otherwise materializes inside the layer scan;
 - causal + left-pad masking fused (same semantics as
   models.llama.prefill_attention_mask: pad_b <= j <= i);
-- GQA folded into the index map: query head h reads KV head h // q_per_kv;
 - blocks strictly above the causal diagonal skip their FLOPs entirely.
 
 Inference-only (no VJP); training uses dense or ring attention.
@@ -60,13 +71,14 @@ def _kernel(
     else:
         q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
         ks_ref = vs_ref = None
-    # q_ref/o_ref [1, 1, BQ, hd]; k_ref/v_ref [1, 1, 1, BK, hd];
+    # q_ref/o_ref [1, 1, G, BQ, hd]; k_ref/v_ref [1, 1, 1, BK, hd];
     # ks_ref/vs_ref [1, 1, KV, BK] (full KV axis — Mosaic requires the
-    # second-minor block dim be 8-divisible or whole; the head's row is
-    # selected in-kernel); scratch acc [BQ, hd] f32, m/l [BQ, LANES] f32
+    # second-minor block dim be 8-divisible or whole; the group's row is
+    # selected in-kernel); scratch acc [G*BQ, hd] f32, m/l [G*BQ, LANES]
+    # f32 — per-head state lives in static G-slices of one buffer
 
     b = pl.program_id(0)
-    h = pl.program_id(1)
+    kv = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
     nj = pl.num_programs(3)
@@ -93,28 +105,20 @@ def _kernel(
         & ((win == 0) | (k_start + block_k - 1 >= q_start - win + 1))
     )
     def _compute():
-        # MXU inputs stay in the QUERY dtype with f32 accumulation
-        # (preferred_element_type): f32 parity tests keep exact f32 dots,
-        # the engine's bf16 takes the native-rate MXU path. Measured
-        # NEUTRAL on wall (the kernel is DMA-granularity-bound, not
-        # compute-bound — the 1024-wide blocks are the actual win, see
-        # module header + artifacts/flash_block_geometry.json); kept
-        # because f32 dots waste MXU throughput headroom for nothing the
-        # f32 oracle tests need. int8 cache values (-128..127) are exactly
-        # representable in bf16, so the dequant algebra is unchanged.
-        qb = q_ref[0, 0]
-        kb = k_ref[0, 0, 0].astype(qb.dtype)
-        vb = v_ref[0, 0, 0].astype(qb.dtype)
+        # casts hoisted out of the G-unroll: one [BK, hd] conversion per
+        # grid cell, not G (int8 cache values are exact in the query
+        # dtype — see the dot comment below)
+        kb = k_ref[0, 0, 0].astype(q_ref.dtype)
+        vb = v_ref[0, 0, 0].astype(q_ref.dtype)
 
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [BQ, BK] f32
-        if quantized:
-            s = s * ks_ref[0, 0, h // q_per_kv][None, :]
-
-        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        # mask depends on positions only, not the head — ONE copy serves
+        # the whole GQA group (a third of the old per-head VPU bookkeeping)
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
         pad = pad_ref[b]
         # k_pos <= q_pos also kills the masked tail of a partial K block
         # (those slots have k_pos > any valid q_pos); q_pos of a partial
@@ -127,32 +131,53 @@ def _kernel(
             & (q_pos < off_ref[0] + seq_len)
         )
         mask = mask & ((win == 0) | (k_pos > q_pos - win))
-        s = jnp.where(mask, s, _NEG)
 
-        m_prev = m_ref[:, :1]                       # [BQ, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)   # [BQ, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)                 # dead rows stay dead
+        for g in range(q_per_kv):  # static unroll over the GQA group
+            lo, hi = g * block_q, (g + 1) * block_q
+            # MXU inputs stay in the QUERY dtype with f32 accumulation
+            # (preferred_element_type): f32 parity tests keep exact f32
+            # dots, the engine's bf16 takes the native-rate MXU path.
+            # Measured NEUTRAL on wall (the kernel is DMA-bound — the
+            # block geometry and the once-per-group K/V stream are the
+            # wins); kept because f32 dots waste MXU headroom for nothing
+            # the f32 oracle tests need. int8 cache values (-128..127)
+            # are exactly representable in bf16, so the dequant algebra
+            # is unchanged.
+            qg = q_ref[0, 0, g]
+            s = jax.lax.dot_general(
+                qg, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [BQ, BK] f32
+            if quantized:
+                s = s * ks_ref[0, 0, kv][None, :]
+            s = jnp.where(mask, s, _NEG)
 
-        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        if quantized:
-            p = p * vs_ref[0, 0, h // q_per_kv][None, :]
-        # probabilities drop to the query dtype for the PV dot (bf16 adds
-        # ~0.4% relative rounding — same class as the int8 V scale already
-        # applied above); accumulation stays f32 in acc_ref
-        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p.astype(qb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+            m_prev = m_ref[lo:hi, :1]                   # [BQ, 1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)   # [BQ, 1]
+            m_new = jnp.maximum(m_prev, m_cur)
+            corr = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            p = jnp.where(mask, p, 0.0)                 # dead rows stay dead
+
+            l_new = l_ref[lo:hi, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+            if quantized:
+                p = p * vs_ref[0, 0, kv][None, :]
+            # probabilities drop to the query dtype for the PV dot (bf16
+            # adds ~0.4% relative rounding — same class as the int8 V
+            # scale already applied above); accumulation stays f32
+            acc_ref[lo:hi] = acc_ref[lo:hi] * corr + jax.lax.dot_general(
+                p.astype(qg.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[lo:hi] = jnp.broadcast_to(m_new, (block_q, m_ref.shape[1]))
+            l_ref[lo:hi] = jnp.broadcast_to(l_new, (block_q, l_ref.shape[1]))
 
     @pl.when(j == nj - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        for g in range(q_per_kv):
+            lo, hi = g * block_q, (g + 1) * block_q
+            l = jnp.maximum(l_ref[lo:hi, :1], 1e-30)
+            o_ref[0, 0, g] = (acc_ref[lo:hi] / l).astype(o_ref.dtype)
 
 
 def supports_flash(seq_len: int, cache_len: int, head_dim: int) -> bool:
@@ -199,16 +224,34 @@ def flash_prefill_attention(
     L, _, KV, C, _ = k_all.shape
     if hd % _LANES and not interpret:
         raise ValueError(f"unsupported flash head_dim={hd}")
-    # default blocks scale inversely with head_dim so the per-step VMEM
-    # footprint stays at the measured-good hd=128 level: 1024x1024 tiles
-    # at hd=256 (Gemma3) would match the 2048-block geometry that fails
-    # to compile (VMEM) — hd=256 resolves to the 512 blocks the full
-    # 34-layer gemma3-4b is measured with (artifacts/multimodel_sweep.json)
-    default_block = max(512, 1024 * _LANES // max(hd, 1))
-    bq = min(block_q or default_block, S)
-    bk = min(block_k or default_block, C)
+    G = H // KV
+    if q_per_kv != G:
+        # the group-major grid derives G from the shapes; a mismatched
+        # caller value would silently change the head->KV mapping
+        raise ValueError(f"q_per_kv={q_per_kv} inconsistent with H/KV={G}")
+    # measured-best geometry for the GROUP-major grid (worst e2e chunk,
+    # B=16/S=2048@off=6144/C=8320 int8: 512/2048 = 30.8 ms/layer vs the
+    # per-head kernel's best 37.5; map shape 19.4 vs 20.7). Two VMEM
+    # scaling rules keep the ~16 MB scoped budget at the measured G=3,
+    # hd=128 level: the K width shrinks with head_dim (hd=256 Gemma3 →
+    # bk 1024), AND with the group size — the per-head loop is a static
+    # unroll whose [bq, bk] f32 score temporaries stay live per head, so
+    # G=4 at bk=2048 exceeds scoped vmem by ~2 MB (measured compile OOM;
+    # G*bk is held ≤ 3*2048). bq stays 512: the q tile already carries
+    # G*512 rows, and bq=1024 geometries fail to compile at G=3.
+    default_bk = max(512, 2048 * _LANES // max(hd, 1))
+    while G * default_bk > 3 * 2048 and default_bk > 512:
+        default_bk //= 2
+    bq = min(block_q or 512, S)
+    # scratch is G-sliced at multiples of bq — keep the slice offsets
+    # sublane-aligned when S is small and not 8-divisible
+    bq = -(-bq // 8) * 8
+    bk = min(block_k or default_bk, C)
 
-    qt = q.transpose(0, 2, 1, 3)   # [B, H, S, hd]
+    # group-major query layout: [B, KV, G, S, hd] — the grid walks KV
+    # heads, so one grid cell computes the whole GQA group against each
+    # K/V block (DMA'd once, not G times)
+    qt = q.transpose(0, 2, 1, 3).reshape(B, KV, G, S, hd)
 
     def visible_j(i, j, win, off):
         # causal: last block any row sees (rows start at off + i*bq)
@@ -221,16 +264,16 @@ def flash_prefill_attention(
         )
         return jnp.clip(j, lo, j_hi)
 
-    def kv_index(b, h, i, j, lidx, pad, win, off, g=q_per_kv):
-        return (lidx[0], b, h // g, visible_j(i, j, win, off), 0)
+    def kv_index(b, kv, i, j, lidx, pad, win, off):
+        return (lidx[0], b, kv, visible_j(i, j, win, off), 0)
 
-    def scale_index(b, h, i, j, lidx, pad, win, off):
+    def scale_index(b, kv, i, j, lidx, pad, win, off):
         return (lidx[0], b, 0, visible_j(i, j, win, off))
 
     in_specs = [
         pl.BlockSpec(
-            (1, 1, bq, hd),
-            lambda b, h, i, j, lidx, pad, win, off: (b, h, i, 0),
+            (1, 1, G, bq, hd),
+            lambda b, kv, i, j, lidx, pad, win, off: (b, kv, 0, i, 0),
         ),
         pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
         pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
@@ -243,10 +286,10 @@ def flash_prefill_attention(
         ]
         operands += [cache["ks"], cache["vs"]]
 
-    grid = (B, H, pl.cdiv(S, bq), pl.cdiv(C, bk))
+    grid = (B, KV, pl.cdiv(S, bq), pl.cdiv(C, bk))
     kernel = functools.partial(
         _kernel, block_q=bq, block_k=bk, seq_len=S, scale=1.0 / (hd ** 0.5),
-        quantized=quantized, q_per_kv=q_per_kv,
+        quantized=quantized, q_per_kv=G,
     )
     out = pl.pallas_call(
         kernel,
@@ -255,16 +298,16 @@ def flash_prefill_attention(
             grid=grid,
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 1, bq, hd),
-                lambda b, h, i, j, lidx, pad, win, off: (b, h, i, 0),
+                (1, 1, G, bq, hd),
+                lambda b, kv, i, j, lidx, pad, win, off: (b, kv, 0, i, 0),
             ),
             scratch_shapes=[
-                pltpu.VMEM((bq, hd), jnp.float32),
-                pltpu.VMEM((bq, _LANES), jnp.float32),
-                pltpu.VMEM((bq, _LANES), jnp.float32),
+                pltpu.VMEM((G * bq, hd), jnp.float32),
+                pltpu.VMEM((G * bq, _LANES), jnp.float32),
+                pltpu.VMEM((G * bq, _LANES), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, S, hd), q.dtype),
         interpret=interpret,
     )(
         jnp.asarray(layer_idx, jnp.int32).reshape(1),
@@ -273,4 +316,4 @@ def flash_prefill_attention(
         jnp.asarray(0 if q_offset is None else q_offset, jnp.int32).reshape(1),
         *operands,
     )
-    return out.transpose(0, 2, 1, 3)
+    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
