@@ -1,0 +1,414 @@
+"""Serving benchmark: micro-batching server vs the serial demo-server shape.
+
+Hermetic by construction — both servers run the FakeBackend with the same
+latency model (a fixed per-dispatch cost plus a small per-prompt marginal
+cost, the economics of a real device batch), so the measured difference is
+pure scheduling: one-request-at-a-time behind a lock (how demo/server.py
+worked before the serve rebase, and how the reference's Ollama loop behaves)
+vs coalesced engine batches through vnsum_tpu.serve.
+
+Two load shapes:
+- closed loop: N concurrent clients with persistent connections, each
+  issuing back-to-back requests — the "16 concurrent users" acceptance
+  shape. Reports p50/p95/p99 latency and GOODPUT (requests completed within
+  their deadline per second).
+- overload: a worker pool several times the engine's concurrency sends
+  back-to-back requests with a TIGHT deadline against a bounded queue —
+  admission control and deadline shedding answer with typed 429s instead of
+  letting latency grow without bound, and the shed counters land in
+  /metrics.
+
+    python scripts/bench_serving.py --out BENCH_serving_r01.json
+
+The latency model (40 ms/dispatch + 3 ms/prompt) is the measured shape of
+the one-chip engine at summary lengths scaled down ~10x so the bench runs
+in seconds; the RATIO between serial and batched serving is what the number
+means, not the absolute latencies.
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import logging
+import sys
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from vnsum_tpu.backend.fake import FakeBackend  # noqa: E402
+from vnsum_tpu.serve.server import ServeState, make_server  # noqa: E402
+
+PROMPT = "Tóm tắt văn bản sau: nội dung tiếng Việt có dấu thanh. " * 8
+
+
+# -- the pre-serve baseline: one request at a time behind a lock -------------
+
+
+def make_serial_server(backend: FakeBackend) -> ThreadingHTTPServer:
+    """The demo server's pre-rebase shape (and the reference's serial Ollama
+    loop): every request takes a global lock around backend.generate, so
+    concurrent clients queue behind each other, one dispatch per request."""
+    lock = threading.Lock()
+
+    class Server(ThreadingHTTPServer):
+        request_queue_size = 128  # match the serve server's listen backlog
+        daemon_threads = True
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # same keep-alive as the serve server
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            with lock:
+                outs = backend.generate([req["prompt"]])
+            body = json.dumps({"completions": [{"text": outs[0]}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    return Server(("127.0.0.1", 0), Handler)
+
+
+# -- persistent-connection client -------------------------------------------
+
+
+class Client:
+    """One keep-alive connection; reconnects transparently. A fresh TCP
+    handshake per request would make the load generator the bottleneck and
+    measure socket churn instead of scheduling."""
+
+    def __init__(self, base: str) -> None:
+        u = urllib.parse.urlparse(base)
+        self.host, self.port = u.hostname, u.port
+        self.conn: http.client.HTTPConnection | None = None
+
+    def connect(self) -> None:
+        """Establish the connection eagerly (before a start barrier), so the
+        measured window contains requests, not a TCP connect herd."""
+        if self.conn is None:
+            self.conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=60
+            )
+            self.conn.connect()
+
+    def post(self, path: str, payload: dict) -> tuple[int, bytes]:
+        """Returns (status, raw body). The body is NOT parsed here: the load
+        shapes only branch on status, and json.loads on every response is
+        measurable GIL work that competes with the server under test on a
+        small host."""
+        body = json.dumps(payload)
+        for attempt in (0, 1):
+            if self.conn is None:
+                self.conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=60
+                )
+            try:
+                self.conn.request(
+                    "POST", path, body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = self.conn.getresponse()
+                data = resp.read()  # must drain for keep-alive reuse
+                return resp.status, data
+            except (http.client.HTTPException, OSError):
+                self.conn.close()
+                self.conn = None
+                if attempt:
+                    raise
+        raise RuntimeError("unreachable")
+
+    def close(self) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+# -- load shapes -------------------------------------------------------------
+
+
+def _percentiles(latencies: list[float]) -> dict:
+    latencies = sorted(latencies)
+
+    def pct(p):
+        if not latencies:
+            return 0.0
+        return latencies[min(int(len(latencies) * p), len(latencies) - 1)]
+
+    return {
+        "p50_s": round(pct(0.50), 4),
+        "p95_s": round(pct(0.95), 4),
+        "p99_s": round(pct(0.99), 4),
+    }
+
+
+def closed_loop(base: str, clients: int, per_client: int,
+                deadline_s: float) -> dict:
+    """N clients, each firing back-to-back requests; a request is GOOD when
+    it completes (HTTP 200) within deadline_s of its submission."""
+    latencies: list[float] = []
+    good = bad = shed = errors = 0
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients + 1)
+
+    def client_fn():
+        nonlocal good, bad, shed, errors
+        c = Client(base)
+        c.connect()
+        barrier.wait()
+        for _ in range(per_client):
+            t0 = time.monotonic()
+            try:
+                status, _ = c.post(
+                    "/v1/generate",
+                    {"prompt": PROMPT, "deadline_ms": deadline_s * 1000},
+                )
+                dt = time.monotonic() - t0
+                with lock:
+                    if status == 200:
+                        latencies.append(dt)
+                        if dt <= deadline_s:
+                            good += 1
+                        else:
+                            bad += 1
+                    elif status == 429:
+                        shed += 1
+                    else:
+                        errors += 1
+            except Exception:
+                with lock:
+                    errors += 1
+        c.close()
+
+    threads = [threading.Thread(target=client_fn) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    total = clients * per_client
+    return {
+        "clients": clients,
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "throughput_rps": round((good + bad) / wall, 2) if wall else 0.0,
+        "goodput_rps": round(good / wall, 2) if wall else 0.0,
+        "good": good,
+        "deadline_missed": bad,
+        "shed": shed,
+        "errors": errors,
+        **_percentiles(latencies),
+    }
+
+
+def overload_loop(base: str, workers: int, duration_s: float,
+                  deadline_s: float) -> dict:
+    """Open-style overload: a worker pool far above engine concurrency fires
+    back-to-back with a deadline tighter than the queueing it would take to
+    serve everyone — the bounded queue and deadline shedding must convert
+    the excess into typed 429s rather than unbounded latency."""
+    latencies: list[float] = []
+    counts = {"good": 0, "late": 0, "shed": 0, "errors": 0}
+    lock = threading.Lock()
+    barrier = threading.Barrier(workers + 1)
+    t_end = [0.0]
+
+    def worker():
+        c = Client(base)
+        c.connect()
+        barrier.wait()
+        while time.monotonic() < t_end[0]:
+            t0 = time.monotonic()
+            try:
+                status, _ = c.post(
+                    "/v1/generate",
+                    {"prompt": PROMPT, "deadline_ms": deadline_s * 1000},
+                )
+                dt = time.monotonic() - t0
+                with lock:
+                    if status == 200:
+                        latencies.append(dt)
+                        counts["good" if dt <= deadline_s else "late"] += 1
+                    elif status == 429:
+                        counts["shed"] += 1
+                    else:
+                        counts["errors"] += 1
+            except Exception:
+                with lock:
+                    counts["errors"] += 1
+        c.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    t_end[0] = time.monotonic() + duration_s
+    barrier.wait()
+    for t in threads:
+        t.join()
+    submitted = sum(counts.values())
+    return {
+        "workers": workers,
+        "duration_s": duration_s,
+        "deadline_s": deadline_s,
+        "submitted": submitted,
+        **counts,
+        **_percentiles(latencies),
+    }
+
+
+# -- main --------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=16)
+    p.add_argument("--per-client", type=int, default=25)
+    p.add_argument("--deadline-s", type=float, default=2.0)
+    # keep the model heavy enough that load-generator CPU (HTTP + JSON on
+    # the same small host) is noise against engine time — a real TPU
+    # summarize dispatch is ~1s/batch (BENCH round 5), so 100ms is still
+    # conservatively LIGHT; a 40ms model let host jitter swing the ratio
+    p.add_argument("--batch-overhead-s", type=float, default=0.100)
+    p.add_argument("--per-prompt-s", type=float, default=0.005)
+    p.add_argument("--max-batch", type=int, default=16)
+    # window > one client re-post round trip on a small/noisy host: with a
+    # 2.0s deadline and 180ms full-batch engine time, waiting up to 150ms
+    # for company costs bounded latency but keeps occupancy near max_batch
+    # even when CFS-throttled clients are slow to re-post (a 25ms window
+    # fragmented batches to ~10 occupancy on a loaded 2-core box; the
+    # server's own default stays 10ms — this is the throughput-biased
+    # setting for a saturated closed loop)
+    p.add_argument("--max-wait-ms", type=float, default=150.0)
+    p.add_argument("--overload-workers", type=int, default=96)
+    p.add_argument("--overload-s", type=float, default=3.0)
+    # ~2.7 engine cycles at the default model: deep-queued requests expire
+    # (deadline sheds) while the standing 96-worker backlog still overflows
+    # the 64-deep queue (queue_full sheds) — a tighter deadline purges the
+    # queue so fast the depth cap never trips and only one counter moves
+    p.add_argument("--overload-deadline-s", type=float, default=0.5)
+    p.add_argument("--out", default="BENCH_serving_r01.json")
+    p.add_argument("--min-speedup", type=float, default=4.0,
+                   help="exit non-zero below this goodput ratio (CI smoke "
+                        "passes a softer floor: shared 2-core runners get "
+                        "CFS-throttled mid-run, which only slows the serve "
+                        "phase — the serial baseline is sleep-bound)")
+    args = p.parse_args(argv)
+
+    # per-request access logging costs real wall clock at bench rates and
+    # measures the logger, not the scheduler
+    logging.getLogger("vnsum.serve.http").setLevel(logging.WARNING)
+
+    lat = dict(batch_overhead_s=args.batch_overhead_s,
+               per_prompt_s=args.per_prompt_s)
+
+    # 1) serial baseline
+    serial_backend = FakeBackend(**lat)
+    serial = make_serial_server(serial_backend)
+    st = threading.Thread(target=serial.serve_forever, daemon=True)
+    st.start()
+    serial_base = f"http://127.0.0.1:{serial.server_address[1]}"
+    print(f"serial baseline on {serial_base} ...", flush=True)
+    serial_closed = closed_loop(
+        serial_base, args.clients, args.per_client, args.deadline_s
+    )
+    serial.shutdown()
+    serial.server_close()
+    serial_closed["engine_batches"] = len(serial_backend.batch_sizes)
+    serial_closed["avg_batch_occupancy"] = 1.0
+
+    # 2) micro-batching serve server — same latency model
+    serve_backend = FakeBackend(**lat)
+    state = ServeState(
+        serve_backend,
+        max_batch=args.max_batch,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        max_queue_depth=64,
+    )
+    server = make_server(state, "127.0.0.1", 0)
+    vt = threading.Thread(target=server.serve_forever, daemon=True)
+    vt.start()
+    serve_base = f"http://127.0.0.1:{server.server_address[1]}"
+    print(f"serve server on {serve_base} ...", flush=True)
+    serve_closed = closed_loop(
+        serve_base, args.clients, args.per_client, args.deadline_s
+    )
+    nb = len(serve_backend.batch_sizes)
+    serve_closed["engine_batches"] = nb
+    serve_closed["avg_batch_occupancy"] = (
+        round(sum(serve_backend.batch_sizes) / nb, 2) if nb else 0.0
+    )
+
+    # 3) overload: bounded queue + tight deadline -> typed sheds
+    print("overload phase ...", flush=True)
+    overload = overload_loop(
+        serve_base, args.overload_workers, args.overload_s,
+        args.overload_deadline_s,
+    )
+    u = urllib.parse.urlparse(serve_base)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+    conn.request("GET", "/metrics")
+    metrics_text = conn.getresponse().read().decode()
+    conn.close()
+    shed_lines = [
+        l for l in metrics_text.splitlines()
+        if l.startswith("vnsum_serve_requests_shed_total")
+    ]
+    server.shutdown()
+    server.server_close()
+    state.close()
+
+    speedup = (
+        serve_closed["goodput_rps"] / serial_closed["goodput_rps"]
+        if serial_closed["goodput_rps"]
+        else float("inf")
+    )
+    stats = state.scheduler.metrics.snapshot()
+    out = {
+        "bench": "serving_micro_batching_vs_serial",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "latency_model": {
+            **lat,
+            "note": "FakeBackend device-dispatch model: fixed per-call + "
+                    "marginal per-prompt cost; ratio is the result, not "
+                    "absolute latency",
+        },
+        "policy": {
+            "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+            "max_queue_depth": 64,
+            "deadline_s": args.deadline_s,
+        },
+        "closed_loop": {
+            "serial_baseline": serial_closed,
+            "serve": serve_closed,
+            "goodput_speedup": round(speedup, 2),
+        },
+        "overload": {
+            **overload,
+            "shed_counters": shed_lines,
+        },
+        "serving_stats": stats.to_dict(),
+    }
+    Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out["closed_loop"], indent=2))
+    print(f"goodput speedup: {speedup:.2f}x "
+          f"({serve_closed['goodput_rps']} vs {serial_closed['goodput_rps']} rps)")
+    print(f"sheds under overload: {overload['shed']} "
+          f"(metrics: {shed_lines})")
+    print(f"wrote {args.out}")
+    return 0 if speedup >= args.min_speedup else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,202 @@
+"""Serving HTTP front-end tests over a live ThreadingHTTPServer with the
+FakeBackend: /v1/generate, /v1/summarize, /healthz, /metrics, and the typed
+429 shed contract."""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vnsum_tpu.backend.fake import FakeBackend
+from vnsum_tpu.serve.server import ServeState, make_server
+
+DOC = "\n\n".join(
+    f"Đoạn văn {i}: " + "nội dung tiếng Việt có dấu thanh. " * 25
+    for i in range(4)
+)
+
+
+@pytest.fixture()
+def serve_url():
+    state = ServeState(FakeBackend(), max_batch=8, max_wait_s=0.005)
+    server = make_server(state, "127.0.0.1", 0)  # ephemeral port
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}", state
+    server.shutdown()
+    server.server_close()
+    state.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_healthz(serve_url):
+    base, _ = serve_url
+    status, body = _get(base + "/healthz")
+    d = json.loads(body)
+    assert status == 200
+    assert d["status"] == "ok" and d["backend"] == "fake"
+    assert d["queue_depth"] == 0 and d["closed"] is False
+
+
+def test_generate_single_and_batch(serve_url):
+    base, state = serve_url
+    status, d = _post(base + "/v1/generate", {"prompt": "xin chào " * 10})
+    assert status == 200
+    (c,) = d["completions"]
+    assert c["text"]
+    assert c["record"]["status"] == "ok" and c["record"]["batch_size"] >= 1
+    status, d = _post(
+        base + "/v1/generate", {"prompts": ["một " * 8, "hai " * 8]}
+    )
+    assert status == 200 and len(d["completions"]) == 2
+
+
+def test_generate_validation(serve_url):
+    base, _ = serve_url
+    for payload in ({}, {"prompt": ""}, {"prompts": []}, {"prompts": [1]}):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base + "/v1/generate", payload)
+        assert exc.value.code == 400
+
+
+def test_bad_numeric_fields_are_400_not_engine_errors(serve_url):
+    # type-bad knobs must be rejected at the door (400), not forwarded into
+    # the scheduler where they'd fail the batch and count as engine errors
+    base, state = serve_url
+    for payload in (
+        {"prompt": "x", "temperature": "hot"},
+        {"prompt": "x", "deadline_ms": "soon"},
+        {"prompt": "x", "max_new_tokens": "many"},
+        {"prompt": "x", "max_new_tokens": 1.5},
+        {"prompt": "x", "top_k": True},
+    ):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base + "/v1/generate", payload)
+        assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base + "/v1/summarize", {"text": DOC, "max_new_tokens": "many"})
+    assert exc.value.code == 400
+    stats = state.scheduler.metrics.snapshot()
+    assert stats.errors == 0 and stats.submitted == 0
+
+
+def test_generate_expired_deadline_is_429_shed(serve_url):
+    base, _ = serve_url
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base + "/v1/generate",
+              {"prompt": "trễ hạn " * 5, "deadline_ms": 0})
+    assert exc.value.code == 429
+    body = json.loads(exc.value.read())
+    assert body == {"error": "shed", "reason": "deadline"}
+
+
+def test_summarize_full_strategy_with_serving_record(serve_url):
+    base, _ = serve_url
+    status, d = _post(
+        base + "/v1/summarize", {"text": DOC, "approach": "mapreduce"}
+    )
+    assert status == 200
+    assert d["approach"] == "mapreduce" and d["summary"]
+    assert d["num_chunks"] >= 1 and d["llm_calls"] >= 1
+    assert d["serving"]["llm_requests"] == d["llm_calls"]
+    assert d["serving"]["engine_s"] >= 0
+    assert d["serving"]["generated_tokens"] > 0
+
+
+def test_summarize_max_new_tokens_override(serve_url):
+    base, state = serve_url
+    # the override builds an uncached strategy carrying the budget; the
+    # shared per-approach cache stays on the approach default
+    status, d = _post(
+        base + "/v1/summarize",
+        {"text": DOC, "approach": "mapreduce", "max_new_tokens": 77},
+    )
+    assert status == 200 and d["summary"]
+    strat = state.strategy_for("mapreduce", 77)
+    assert strat.max_new_tokens == 77
+    assert state.strategy_for("mapreduce").max_new_tokens != 77
+
+
+def test_summarize_validation(serve_url):
+    base, _ = serve_url
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base + "/v1/summarize", {"text": "   "})
+    assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base + "/v1/summarize", {"text": "x", "approach": "nope"})
+    assert exc.value.code == 400
+    assert "approaches" in json.loads(exc.value.read())
+
+
+def test_concurrent_summarize_requests_share_engine_batches():
+    # own server with a WIDE coalescing window: the assertion is about
+    # packing, and the handler threads racing to submit must not lose to
+    # scheduler flushes on a slow/throttled CI host (5ms flaked there)
+    state = ServeState(FakeBackend(), max_batch=8, max_wait_s=0.25)
+    server = make_server(state, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        n = 4
+        barrier = threading.Barrier(n)
+        out = [None] * n
+
+        def worker(i):
+            barrier.wait()
+            out[i] = _post(
+                base + "/v1/summarize", {"text": DOC, "approach": "truncated"}
+            )
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(status == 200 and d["summary"] for status, d in out)
+        # truncated = 1 LLM call per request; the scheduler should have
+        # packed the 4 concurrent calls into fewer dispatches than requests
+        assert len(state.backend.batch_sizes) < n
+        assert sum(state.backend.batch_sizes) == n
+    finally:
+        server.shutdown()
+        server.server_close()
+        state.close()
+
+
+def test_metrics_endpoint_exposes_serving_counters(serve_url):
+    base, _ = serve_url
+    _post(base + "/v1/generate", {"prompt": "đo lường " * 6})
+    status, body = _get(base + "/metrics")
+    text = body.decode()
+    assert status == 200
+    assert "vnsum_serve_requests_total" in text
+    assert "vnsum_serve_batches_total" in text
+    assert "vnsum_serve_engine_seconds_total" in text
+    assert 'vnsum_serve_requests_shed_total{reason="queue_full"}' in text
+    assert "vnsum_serve_queue_wait_seconds_count" in text
+
+
+def test_unknown_routes_404(serve_url):
+    base, _ = serve_url
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(base + "/nope")
+    assert exc.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base + "/v1/nope", {})
+    assert exc.value.code == 404

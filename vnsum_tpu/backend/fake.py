@@ -6,10 +6,19 @@ Two modes:
   <content>-like region of the prompt — deterministic, content-dependent, and
   shrinking, so collapse loops terminate the way real summarization does;
 - scripted: pop canned responses in order (for critique accept-paths etc.).
+
+An optional latency model (``batch_overhead_s`` + ``per_prompt_s``) makes a
+generate() call sleep like a device dispatch: a fixed per-call cost plus a
+much smaller marginal per-row cost — the economics that make micro-batching
+win. The serving scheduler tests and scripts/bench_serving.py use it to
+measure batching effects hermetically; it defaults off so every existing
+test is unchanged. ``batch_sizes`` records the prompt count of each call
+(``calls`` flattens prompts, which hides batch boundaries).
 """
 from __future__ import annotations
 
 import re
+import time
 
 from ..core.config import GenerationConfig
 from ..text.tokenizer import whitespace_token_count
@@ -28,11 +37,16 @@ class FakeBackend:
         responses: list[str] | None = None,
         summary_words: int = 40,
         prefix: str = "",
+        batch_overhead_s: float = 0.0,
+        per_prompt_s: float = 0.0,
     ) -> None:
         self._responses = list(responses) if responses else None
         self.summary_words = summary_words
         self.prefix = prefix
+        self.batch_overhead_s = batch_overhead_s
+        self.per_prompt_s = per_prompt_s
         self.calls: list[str] = []
+        self.batch_sizes: list[int] = []
 
     def _one(self, prompt: str) -> str:
         if self._responses is not None:
@@ -52,6 +66,9 @@ class FakeBackend:
         config: GenerationConfig | None = None,
     ) -> list[str]:
         self.calls.extend(prompts)
+        self.batch_sizes.append(len(prompts))
+        if self.batch_overhead_s or self.per_prompt_s:
+            time.sleep(self.batch_overhead_s + self.per_prompt_s * len(prompts))
         return [self._one(p) for p in prompts]
 
     def count_tokens(self, text: str) -> int:

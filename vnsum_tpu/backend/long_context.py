@@ -32,7 +32,6 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -59,7 +58,7 @@ from ..models.llama import (
     prefill_positions,
 )
 from ..models.sampling import sample_logits
-from ..parallel.mesh import AXES
+from ..parallel.mesh import AXES, axis_size, shard_map
 from ..parallel.ring import ring_attention
 from ..text.tokenizer import Tokenizer, get_tokenizer
 
@@ -142,7 +141,7 @@ def _prefill_partial_local(
     k_loc/v_loc [B, KV, S_loc, hd] (int8 when k_scale/v_scale [B, KV, S_loc]
     are given). Returns (o [B, H, hd] f32, m, l [B, H]). Dense fallback for
     head dims the Pallas kernel can't take (see _kernel_partial_local)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, hd = q.shape
     KV = k_loc.shape[1]

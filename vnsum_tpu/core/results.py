@@ -70,6 +70,68 @@ class ModelRunRecord:
 
 
 @dataclass
+class ServeRequestRecord:
+    """Per-request online-serving observability (serve/scheduler.py).
+
+    One record per request DISPATCHED to the engine — completed or errored.
+    Shed requests never reach a batch and are counted per-reason in
+    ServingStats.shed instead (their typed RequestShed carries the reason
+    to the caller). The serving HTTP layer returns these inline with
+    responses and the load generator (scripts/bench_serving.py) aggregates
+    them, so the same fields serve live debugging and committed benchmark
+    evidence."""
+
+    request_id: int
+    status: str = "ok"  # ok | error
+    queue_wait_s: float = 0.0  # submit -> engine dispatch
+    engine_s: float = 0.0      # wall clock of the shared engine batch
+    total_s: float = 0.0       # submit -> completion
+    batch_size: int = 0        # occupancy of the engine batch it rode
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class ServingStats:
+    """Aggregate serving counters — the snapshot form of serve.ServeMetrics,
+    embeddable in run records (PipelineResults.serving) and bench JSON."""
+
+    submitted: int = 0
+    completed: int = 0
+    errors: int = 0
+    shed: dict[str, int] = field(default_factory=dict)  # reason -> count
+    batches: int = 0
+    batch_occupancy_sum: int = 0
+    engine_seconds: float = 0.0
+    queue_wait_seconds: float = 0.0
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+
+    @property
+    def shed_total(self) -> int:
+        return sum(self.shed.values())
+
+    @property
+    def avg_batch_occupancy(self) -> float:
+        return self.batch_occupancy_sum / self.batches if self.batches else 0.0
+
+    @property
+    def tokens_per_second(self) -> float:
+        total = self.prompt_tokens + self.generated_tokens
+        return total / self.engine_seconds if self.engine_seconds else 0.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["shed_total"] = self.shed_total
+        d["avg_batch_occupancy"] = self.avg_batch_occupancy
+        d["tokens_per_second"] = self.tokens_per_second
+        return d
+
+
+@dataclass
 class PipelineResults:
     """Top-level run record, persisted as
     evaluation_results/pipeline_results_<ts>.json (ref :927-947)."""
@@ -80,6 +142,9 @@ class PipelineResults:
     summarization: dict[str, Any] = field(default_factory=dict)
     evaluation: dict[str, Any] = field(default_factory=dict)
     tracing: dict[str, Any] = field(default_factory=dict)
+    # online-serving counters (ServingStats.to_dict) when the run went
+    # through vnsum_tpu.serve; empty for offline pipeline runs
+    serving: dict[str, Any] = field(default_factory=dict)
 
     def add_summarization(self, record: ModelRunRecord) -> None:
         self.summarization[record.model] = record.to_dict()
@@ -104,6 +169,7 @@ class PipelineResults:
                 "summarization": self.summarization,
                 "evaluation": self.evaluation,
                 "tracing": self.tracing,
+                "serving": self.serving,
             },
         }
 

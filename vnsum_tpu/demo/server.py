@@ -6,6 +6,13 @@ Single page: paste or pick a document, choose approaches, submit; the page
 renders each approach's summary with chunk/LLM-call/time stats and ROUGE vs
 the reference summary when one is given.
 
+Rebased onto vnsum_tpu.serve: summarize requests used to serialize whole
+runs behind a lock (the backend is not thread-safe); now every approach's
+LLM rounds are submitted through the micro-batching scheduler, so engine
+access still serializes — per BATCH, in the scheduler thread — while
+concurrent demo requests coalesce into shared device batches instead of
+queueing behind each other.
+
     python -m vnsum_tpu.demo.server --backend fake --port 8900
     python -m vnsum_tpu.demo.server --backend tpu --model llama3.2:3b
 """
@@ -13,7 +20,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
@@ -21,6 +27,7 @@ from ..backend.base import Backend, get_backend
 from ..core.config import APPROACHES
 from ..core.logging import get_logger
 from ..data import DocumentDataset
+from ..serve.scheduler import MicroBatchScheduler
 from .core import run_approaches
 
 logger = get_logger("vnsum.demo")
@@ -91,13 +98,31 @@ function run(){
 
 
 class DemoState:
-    def __init__(self, backend: Backend, dataset: DocumentDataset | None = None):
+    def __init__(
+        self,
+        backend: Backend,
+        dataset: DocumentDataset | None = None,
+        *,
+        max_batch: int = 8,
+        max_wait_s: float = 0.005,
+    ):
         self.backend = backend
         self.dataset = dataset
-        # backends are not thread-safe (jit caches, stats, torch modules);
-        # ThreadingHTTPServer keeps the UI responsive while summarize
-        # requests serialize here
-        self.generate_lock = threading.Lock()
+        # backends are not thread-safe (jit caches, stats, torch modules) —
+        # the serve scheduler owns the only thread that touches the engine,
+        # and concurrent summarize requests coalesce into its batches
+        self.scheduler = MicroBatchScheduler(
+            backend, max_batch=max_batch, max_wait_s=max_wait_s
+        )
+
+    def serving_backend(self):
+        """A fresh per-request view: QueuedBackend accumulates per-request
+        observability records, so sharing one across a server's lifetime
+        would grow without bound."""
+        return self.scheduler.backend_view()
+
+    def close(self) -> None:
+        self.scheduler.close(drain=True)
 
 
 def make_handler(state: DemoState):
@@ -167,13 +192,12 @@ def make_handler(state: DemoState):
                     if bad:
                         self._json({"error": f"unknown approaches: {bad}"}, 400)
                         return
-                with state.generate_lock:
-                    runs = run_approaches(
-                        text,
-                        state.backend,
-                        approaches=approaches,
-                        reference=req.get("reference") or None,
-                    )
+                runs = run_approaches(
+                    text,
+                    state.serving_backend(),
+                    approaches=approaches,
+                    reference=req.get("reference") or None,
+                )
                 self._json({"runs": [r.to_dict() for r in runs]})
             except json.JSONDecodeError:
                 self._json({"error": "invalid JSON"}, 400)
@@ -227,6 +251,7 @@ def main(argv: list[str] | None = None) -> int:
         pass
     finally:
         server.server_close()
+        state.close()  # drain in-flight scheduler batches
     return 0
 
 

@@ -18,6 +18,44 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+# shard_map moved homes across JAX releases: top-level `jax.shard_map` on
+# new versions, `jax.experimental.shard_map.shard_map` before that. Every
+# in-repo user (ring attention, long-context engine, sharded kernel
+# wrappers) imports THIS name so the version probe lives in one place.
+try:
+    from jax import shard_map  # jax >= 0.5-ish exports it at top level
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map
+if not callable(shard_map):  # some versions export the submodule instead
+    shard_map = shard_map.shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma; accept the
+# new spelling everywhere and translate for older installs
+import inspect as _inspect
+
+if "check_vma" not in _inspect.signature(shard_map).parameters:
+    _shard_map_raw = shard_map
+
+    def shard_map(*args, **kwargs):  # noqa: F811 - deliberate compat rebind
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_raw(*args, **kwargs)
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis from inside a shard_map body.
+    ``jax.lax.axis_size`` is a late addition; ``psum`` of the constant 1 is
+    the classic spelling and stays static (a Python int) at trace time."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+__all__ = [
+    "AXES", "MeshAxes", "axis_size", "make_mesh", "mesh_from_spec",
+    "shard_map",
+]
+
 
 @dataclass(frozen=True)
 class MeshAxes:

@@ -1,0 +1,34 @@
+"""Online serving layer over the TPU engine.
+
+Every entry point before this package was offline: pipeline/runner.py submits
+one big batch and waits, and the demo server handled one request at a time —
+the exact serial-request shape the reference's Ollama loop had (PAPER.md §7).
+This package is the missing online front-end for the batched engine:
+
+- queue.py      bounded async request queue: per-request deadlines, typed
+                429-style admission control (queue depth + token budget)
+- scheduler.py  micro-batching scheduler thread that coalesces queued
+                requests into shared engine batches (max-wait/max-batch
+                policy), plus the QueuedBackend adapter that lets the
+                existing strategies submit their rounds through the queue
+- metrics.py    per-request + aggregate observability, Prometheus text
+- server.py     stdlib HTTP front-end: /v1/summarize, /v1/generate,
+                /healthz, /metrics  (python -m vnsum_tpu.serve.server)
+
+The engine itself is untouched: ONE scheduler thread owns all
+backend.generate calls (TpuBackend's jit caches and stats are not
+thread-safe), and concurrency lives entirely in front of it.
+"""
+from .queue import RequestQueue, RequestShed, ServeRequest, ShedReason
+from .scheduler import MicroBatchScheduler, QueuedBackend
+from .metrics import ServeMetrics
+
+__all__ = [
+    "MicroBatchScheduler",
+    "QueuedBackend",
+    "RequestQueue",
+    "RequestShed",
+    "ServeMetrics",
+    "ServeRequest",
+    "ShedReason",
+]

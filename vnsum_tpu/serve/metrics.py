@@ -1,0 +1,135 @@
+"""Serving observability: thread-safe counters + Prometheus text export.
+
+Two consumption surfaces off one data structure:
+- GET /metrics renders the Prometheus text format (counters, gauges, and a
+  cumulative histogram for queue wait), so a scrape loop sees queue wait,
+  batch occupancy, time-in-engine, tokens/s, and shed counts per reason;
+- snapshot() returns a core.results.ServingStats so run records and the
+  serving benchmark embed the same numbers the scrape endpoint reports —
+  one source of truth, two serializations.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..core.results import ServeRequestRecord, ServingStats
+from .queue import ShedReason
+
+# cumulative histogram bucket upper bounds (seconds) for queue wait — spans
+# sub-millisecond coalescing waits through multi-second overload backlogs
+_WAIT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+class ServeMetrics:
+    """Aggregate counters; observe_* methods are called from the scheduler
+    thread and the HTTP handler threads, so everything locks."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats = ServingStats()
+        self._wait_buckets = [0] * (len(_WAIT_BUCKETS) + 1)  # +inf tail
+
+    # -- observation hooks ----------------------------------------------
+
+    def observe_submit(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.submitted += n
+
+    def observe_shed(self, reason: ShedReason, n: int = 1) -> None:
+        with self._lock:
+            key = reason.value
+            self._stats.shed[key] = self._stats.shed.get(key, 0) + n
+
+    def observe_batch(self, occupancy: int, engine_s: float) -> None:
+        with self._lock:
+            self._stats.batches += 1
+            self._stats.batch_occupancy_sum += occupancy
+            self._stats.engine_seconds += engine_s
+
+    def observe_request(self, rec: ServeRequestRecord) -> None:
+        with self._lock:
+            if rec.status == "ok":
+                self._stats.completed += 1
+            elif rec.status == "error":
+                self._stats.errors += 1
+            self._stats.queue_wait_seconds += rec.queue_wait_s
+            self._stats.prompt_tokens += rec.prompt_tokens
+            self._stats.generated_tokens += rec.generated_tokens
+            for i, ub in enumerate(_WAIT_BUCKETS):
+                if rec.queue_wait_s <= ub:
+                    self._wait_buckets[i] += 1
+                    break
+            else:
+                self._wait_buckets[-1] += 1
+
+    # -- export ----------------------------------------------------------
+
+    def snapshot(self) -> ServingStats:
+        import copy
+
+        with self._lock:
+            return copy.deepcopy(self._stats)
+
+    def render_prometheus(self, queue_depth: int | None = None,
+                          queued_tokens: int | None = None) -> str:
+        import copy
+
+        # one lock acquisition for stats AND buckets: a scrape must not see
+        # a histogram count that disagrees with the counters it shipped with
+        with self._lock:
+            s = copy.deepcopy(self._stats)
+            buckets = list(self._wait_buckets)
+        lines = []
+
+        def counter(name, value, help_, labels=""):
+            lines.append(f"# HELP vnsum_serve_{name} {help_}")
+            lines.append(f"# TYPE vnsum_serve_{name} counter")
+            lines.append(f"vnsum_serve_{name}{labels} {value}")
+
+        def gauge(name, value, help_):
+            lines.append(f"# HELP vnsum_serve_{name} {help_}")
+            lines.append(f"# TYPE vnsum_serve_{name} gauge")
+            lines.append(f"vnsum_serve_{name} {value}")
+
+        counter("requests_total", s.submitted, "requests admitted to the queue")
+        counter("requests_completed_total", s.completed, "requests answered")
+        counter("requests_errored_total", s.errors, "requests failed in the engine")
+        lines.append("# HELP vnsum_serve_requests_shed_total requests shed, by reason")
+        lines.append("# TYPE vnsum_serve_requests_shed_total counter")
+        for reason in ShedReason:
+            lines.append(
+                f'vnsum_serve_requests_shed_total{{reason="{reason.value}"}} '
+                f"{s.shed.get(reason.value, 0)}"
+            )
+        counter("batches_total", s.batches, "engine batches dispatched")
+        counter("batch_occupancy_sum", s.batch_occupancy_sum,
+                "sum of engine batch occupancies (avg = sum / batches_total)")
+        counter("engine_seconds_total", round(s.engine_seconds, 6),
+                "wall-clock seconds spent inside backend.generate")
+        counter("queue_wait_seconds_total", round(s.queue_wait_seconds, 6),
+                "total seconds requests spent queued before dispatch")
+        counter("prompt_tokens_total", s.prompt_tokens, "prompt tokens admitted")
+        counter("generated_tokens_total", s.generated_tokens, "tokens generated")
+        gauge("tokens_per_second", round(s.tokens_per_second, 3),
+              "cumulative (prompt+generated) tokens / engine second")
+        if queue_depth is not None:
+            gauge("queue_depth", queue_depth, "requests currently queued")
+        if queued_tokens is not None:
+            gauge("queued_tokens", queued_tokens,
+                  "prompt-token estimate currently queued")
+
+        lines.append("# HELP vnsum_serve_queue_wait_seconds queue wait histogram")
+        lines.append("# TYPE vnsum_serve_queue_wait_seconds histogram")
+        cum = 0
+        for ub, n in zip(_WAIT_BUCKETS, buckets):
+            cum += n
+            lines.append(
+                f'vnsum_serve_queue_wait_seconds_bucket{{le="{ub}"}} {cum}'
+            )
+        cum += buckets[-1]
+        lines.append(f'vnsum_serve_queue_wait_seconds_bucket{{le="+Inf"}} {cum}')
+        lines.append(
+            f"vnsum_serve_queue_wait_seconds_sum {round(s.queue_wait_seconds, 6)}"
+        )
+        lines.append(f"vnsum_serve_queue_wait_seconds_count {cum}")
+        return "\n".join(lines) + "\n"

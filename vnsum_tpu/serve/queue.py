@@ -1,0 +1,244 @@
+"""Bounded request queue with SLO-aware admission control.
+
+Admission is decided at submit time against two budgets — queue depth and
+total queued prompt tokens — and rejection is a TYPED result (RequestShed
+with a ShedReason), not a dropped connection: the HTTP layer maps it to a
+429-style response, the QueuedBackend adapter re-raises it into the calling
+strategy, and the metrics layer counts it per reason. Requests carry an
+absolute monotonic deadline; expired requests are shed at dispatch time so a
+backed-up queue never spends engine capacity on answers nobody is waiting
+for (BASS, arXiv:2404.15778 frames both as the load-shedding half of
+continuous batching).
+
+The queue itself is deliberately dumb: ordering is FIFO, and all batching
+policy (compatibility keys, max-wait/max-batch) lives in take_batch's
+caller-supplied parameters so the scheduler owns the policy and the queue
+owns the synchronization.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from enum import Enum
+
+from ..core.config import GenerationConfig
+
+
+class ShedReason(str, Enum):
+    QUEUE_FULL = "queue_full"
+    TOKEN_BUDGET = "token_budget"
+    DEADLINE = "deadline"
+    SHUTDOWN = "shutdown"
+
+
+class RequestShed(RuntimeError):
+    """Typed 429-style rejection: admission control or deadline shedding.
+
+    Raised synchronously by submit() (admission) or delivered through the
+    request future (deadline/shutdown shedding after the request was
+    admitted)."""
+
+    def __init__(self, reason: ShedReason, detail: str = "") -> None:
+        self.reason = reason
+        super().__init__(
+            f"request shed ({reason.value})" + (f": {detail}" if detail else "")
+        )
+
+
+_ids = itertools.count()
+
+
+@dataclass
+class ServeRequest:
+    """One prompt awaiting a shared engine batch."""
+
+    prompt: str
+    max_new_tokens: int | None = None
+    config: GenerationConfig | None = None
+    # absolute time.monotonic() deadline; None = no SLO
+    deadline: float | None = None
+    est_tokens: int = 0
+    request_id: int = field(default_factory=lambda: next(_ids))
+    enqueued_at: float = field(default_factory=time.monotonic)
+    future: Future = field(default_factory=Future)
+
+    def batch_key(self) -> tuple:
+        """Requests sharing this key can ride one engine batch: the engine
+        applies max_new_tokens and the GenerationConfig per CALL, not per
+        row, so only same-parameter requests may coalesce. GenerationConfig
+        is frozen/hashable by construction."""
+        return (self.max_new_tokens, self.config)
+
+    def expired(self, now: float | None = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+
+class RequestQueue:
+    """FIFO queue with depth + token-budget admission and batch take-out.
+
+    ``max_depth`` bounds queued requests; ``max_queued_tokens`` (0 =
+    unlimited) bounds the sum of queued prompt-token estimates so a few
+    book-length prompts can't squeeze out hundreds of short ones while
+    nominally fitting the depth budget."""
+
+    def __init__(self, max_depth: int = 256, max_queued_tokens: int = 0) -> None:
+        self.max_depth = max_depth
+        self.max_queued_tokens = max_queued_tokens
+        self._items: list[ServeRequest] = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queued_tokens = 0
+        self._closed = False
+        self.on_shed = None  # callable(req, ShedReason) | None — metrics hook
+        # called under the queue lock BEFORE the scheduler can take the
+        # request: counting the admit here means no scrape window where a
+        # request is completed but not yet counted as submitted
+        self.on_admit = None  # callable(req) | None — metrics hook
+
+    # -- producer side ---------------------------------------------------
+
+    def submit(self, req: ServeRequest, *, force: bool = False) -> Future:
+        """Admit or shed. Sheds raise RequestShed SYNCHRONOUSLY (the caller
+        never gets a future that was doomed at admission).
+
+        ``force=True`` skips the depth/token-budget checks (not the
+        shutdown/deadline ones): it is for the INTERNAL fan-out of work
+        that was already admitted at the request level — e.g. a summarize
+        request whose map round splits into more prompts than max_depth
+        must not shed itself against an idle server. External entry points
+        must never set it."""
+        with self._cond:
+            if self._closed:
+                self._shed_locked(req, ShedReason.SHUTDOWN)
+            if req.expired():
+                self._shed_locked(req, ShedReason.DEADLINE)
+            if not force:
+                reason = self._admission_reason_locked(req.est_tokens)
+                if reason is not None:
+                    self._shed_locked(req, reason)
+            self._items.append(req)
+            self._queued_tokens += req.est_tokens
+            if self.on_admit is not None:
+                self.on_admit(req)
+            self._cond.notify_all()
+        return req.future
+
+    def _admission_reason_locked(self, est_tokens: int) -> ShedReason | None:
+        """The ONE depth/token-budget admission predicate — submit() and
+        check_admission() must never diverge on policy."""
+        if len(self._items) >= self.max_depth:
+            return ShedReason.QUEUE_FULL
+        if (
+            self.max_queued_tokens
+            and self._items  # an empty queue always admits one request
+            and self._queued_tokens + est_tokens > self.max_queued_tokens
+        ):
+            return ShedReason.TOKEN_BUDGET
+        return None
+
+    def check_admission(self, est_tokens: int = 0) -> None:
+        """Request-level admission probe without enqueueing: raises the same
+        typed RequestShed a submit would. Entry points whose work fans out
+        through force-submits (the summarize path) call this ONCE up front
+        so admission control still applies per request."""
+        with self._lock:
+            if self._closed:
+                raise RequestShed(ShedReason.SHUTDOWN)
+            reason = self._admission_reason_locked(est_tokens)
+            if reason is not None:
+                raise RequestShed(reason)
+
+    def _shed_locked(self, req: ServeRequest, reason: ShedReason):
+        if self.on_shed is not None:
+            self.on_shed(req, reason)
+        exc = RequestShed(reason)
+        # resolve the future too, for callers holding it (take-side sheds)
+        if not req.future.done():
+            req.future.set_exception(exc)
+        raise exc
+
+    # -- consumer side ---------------------------------------------------
+
+    def _shed_expired_locked(self, now: float) -> None:
+        live = []
+        for r in self._items:
+            if r.expired(now):
+                self._queued_tokens -= r.est_tokens
+                if self.on_shed is not None:
+                    self.on_shed(r, ShedReason.DEADLINE)
+                if not r.future.done():
+                    r.future.set_exception(RequestShed(ShedReason.DEADLINE))
+            else:
+                live.append(r)
+        self._items = live
+
+    def take_batch(self, max_batch: int, max_wait_s: float) -> list[ServeRequest] | None:
+        """Block until a batch is ready, then return up to ``max_batch``
+        requests sharing the head-of-line request's batch_key. A batch is
+        ready when it is full, when the coalescing window ``max_wait_s`` has
+        elapsed, or when the queue is closed (drain). Returns None when
+        closed and empty — the scheduler's exit signal. Expired requests are
+        shed on every wake-up.
+
+        The window anchors on max(head arrival, THIS CALL's entry): under
+        light load that is head arrival (a lone request waits at most
+        max_wait_s), but after a long engine dispatch the backlog's head is
+        already older than any window — anchoring on entry keeps a brief
+        coalescing window open so requests unblocked by the *previous*
+        batch's responses can join this one instead of fragmenting into
+        near-empty dispatches (measured 4.65 -> ~15 occupancy at 16
+        closed-loop clients, scripts/bench_serving.py)."""
+        t_enter = time.monotonic()
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                self._shed_expired_locked(now)
+                if not self._items:
+                    if self._closed:
+                        return None
+                    self._cond.wait(timeout=0.1)
+                    continue
+                head = self._items[0]
+                key = head.batch_key()
+                compat = [r for r in self._items if r.batch_key() == key]
+                flush_at = max(head.enqueued_at, t_enter) + max_wait_s
+                if len(compat) >= max_batch or now >= flush_at or self._closed:
+                    batch = compat[:max_batch]
+                    taken = set(id(r) for r in batch)
+                    self._items = [r for r in self._items if id(r) not in taken]
+                    for r in batch:
+                        self._queued_tokens -= r.est_tokens
+                    return batch
+                self._cond.wait(timeout=max(flush_at - now, 0.001))
+
+    # -- lifecycle / introspection ---------------------------------------
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admitting. drain=True leaves queued requests for the
+        scheduler to finish; drain=False sheds them immediately."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                for r in self._items:
+                    self._queued_tokens -= r.est_tokens
+                    if self.on_shed is not None:
+                        self.on_shed(r, ShedReason.SHUTDOWN)
+                    if not r.future.done():
+                        r.future.set_exception(RequestShed(ShedReason.SHUTDOWN))
+                self._items = []
+            self._cond.notify_all()
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def queued_tokens(self) -> int:
+        with self._lock:
+            return self._queued_tokens

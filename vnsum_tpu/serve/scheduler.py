@@ -1,0 +1,294 @@
+"""Micro-batching scheduler: many concurrent requests, one engine thread.
+
+The engine already solves the hard problem — a list of prompts becomes
+bucketed, fixed-shape device batches (backend/engine.py) — but it is an
+offline API: someone must hand it the list. This scheduler is that someone
+for online traffic. Requests arrive on arbitrary threads (HTTP handlers,
+strategy rounds), sit in the bounded RequestQueue, and ONE scheduler thread
+coalesces compatible requests (same max_new_tokens + GenerationConfig) into
+shared backend.generate calls under a max-wait/max-batch policy:
+
+- heavy load: batches fill to ``max_batch`` immediately — throughput-optimal,
+  the engine's bucketing amortizes prefill+decode across the batch;
+- light load: a lone request waits at most ``max_wait_s`` before dispatching
+  alone — latency stays bounded instead of waiting for company that never
+  comes (the standard micro-batching latency/throughput dial, BASS
+  arXiv:2404.15778 §3).
+
+Single-threaded engine access is load-bearing, not incidental: TpuBackend's
+jit caches, stats, and dispatch counter are not thread-safe, and the demo
+server previously serialized whole summarize requests behind a lock to cope.
+Here serialization happens per engine BATCH, after coalescing — the lock
+contention becomes the batching opportunity.
+
+QueuedBackend closes the loop for the strategy layer: it implements the
+Backend protocol by submitting each prompt of a strategy round as its own
+queued request and waiting on the futures. Concurrent strategy runs (e.g.
+two /v1/summarize requests in flight) therefore interleave their map/collapse
+rounds into shared engine batches — re-entrant batch submission without the
+strategies knowing the serving layer exists.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..backend.base import Backend
+from ..core.config import GenerationConfig
+from ..core.logging import get_logger
+from ..core.results import ServeRequestRecord
+from .metrics import ServeMetrics
+from .queue import RequestQueue, RequestShed, ServeRequest, ShedReason
+
+logger = get_logger("vnsum.serve")
+
+
+class _Completion:
+    """What a request future resolves to: the text plus its observability
+    record (the HTTP layer returns the record inline with the response)."""
+
+    __slots__ = ("text", "record")
+
+    def __init__(self, text: str, record: ServeRequestRecord) -> None:
+        self.text = text
+        self.record = record
+
+
+class MicroBatchScheduler:
+    def __init__(
+        self,
+        backend: Backend,
+        *,
+        max_batch: int = 8,
+        max_wait_s: float = 0.01,
+        max_queue_depth: int = 256,
+        max_queued_tokens: int = 0,
+        metrics: ServeMetrics | None = None,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.backend = backend
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.metrics = metrics or ServeMetrics()
+        self.queue = RequestQueue(
+            max_depth=max_queue_depth, max_queued_tokens=max_queued_tokens
+        )
+        self.queue.on_shed = self._on_shed
+        self.queue.on_admit = lambda req: self.metrics.observe_submit()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="vnsum-serve-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    # -- submission ------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: str,
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+        deadline: float | None = None,
+        internal: bool = False,
+    ):
+        """Admit one prompt; returns a Future resolving to a _Completion.
+        Raises RequestShed synchronously when admission control rejects.
+        ``internal=True`` marks fan-out of already-admitted work (strategy
+        rounds riding a QueuedBackend): depth/token admission is skipped —
+        the request-level gate is check_admission — while deadline and
+        shutdown shedding still apply."""
+        req = ServeRequest(
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            config=config,
+            deadline=deadline,
+            est_tokens=self.backend.count_tokens(prompt),
+        )
+        # the admit is counted by the queue's on_admit hook, under the queue
+        # lock, so metrics can never show a completion before its submit
+        return self.queue.submit(req, force=internal)  # raises RequestShed
+
+    def check_admission(self, est_tokens: int = 0) -> None:
+        """Request-level admission gate for entry points that fan out via
+        internal submits; sheds are counted in metrics like any other."""
+        try:
+            self.queue.check_admission(est_tokens)
+        except RequestShed as e:
+            self.metrics.observe_shed(e.reason)
+            raise
+
+    def submit_many(self, prompts, **kw):
+        """Admit a round of prompts atomically-ish: if any prompt is shed at
+        admission, already-admitted siblings are left to complete (they
+        occupy queue slots either way) and the shed propagates to the
+        caller — a strategy round is all-or-nothing for its caller."""
+        return [self.submit(p, **kw) for p in prompts]
+
+    def generate_sync(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+        deadline: float | None = None,
+        internal: bool = False,
+    ) -> list[_Completion]:
+        futs = self.submit_many(
+            prompts, max_new_tokens=max_new_tokens, config=config,
+            deadline=deadline, internal=internal,
+        )
+        return [f.result() for f in futs]
+
+    def backend_view(self, deadline: float | None = None) -> "QueuedBackend":
+        """A Backend-protocol view whose generate() routes through this
+        scheduler — hand it to a strategy to make its rounds coalesce with
+        everyone else's."""
+        return QueuedBackend(self, deadline=deadline)
+
+    # -- scheduler thread ------------------------------------------------
+
+    def _on_shed(self, req: ServeRequest, reason: ShedReason) -> None:
+        self.metrics.observe_shed(reason)
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                batch = self.queue.take_batch(self.max_batch, self.max_wait_s)
+            except Exception:  # pragma: no cover - queue bugs must not kill serving
+                logger.exception("take_batch failed; scheduler continuing")
+                continue
+            if batch is None:
+                return  # closed and drained
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # pragma: no cover - belt and braces
+                # _run_batch guards backend.generate, but anything raising
+                # after it (token counting, metrics) must not kill the
+                # scheduler thread: callers block on these futures forever
+                # and /healthz would keep reporting ok
+                logger.exception("batch post-processing failed")
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    def _run_batch(self, batch: list[ServeRequest]) -> None:
+        head = batch[0]
+        t0 = time.monotonic()
+        try:
+            outs = self.backend.generate(
+                [r.prompt for r in batch],
+                max_new_tokens=head.max_new_tokens,
+                config=head.config,
+            )
+        except Exception as e:
+            engine_s = time.monotonic() - t0
+            self.metrics.observe_batch(len(batch), engine_s)
+            logger.exception("engine batch of %d failed", len(batch))
+            for r in batch:
+                rec = self._record(r, "error", t0, engine_s, len(batch), 0)
+                self.metrics.observe_request(rec)
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        engine_s = time.monotonic() - t0
+        self.metrics.observe_batch(len(batch), engine_s)
+        if len(outs) != len(batch):
+            # a zip would silently drop the tail and strand its futures
+            e = RuntimeError(
+                f"backend returned {len(outs)} outputs for a batch of "
+                f"{len(batch)}"
+            )
+            logger.error(str(e))
+            for r in batch:
+                rec = self._record(r, "error", t0, engine_s, len(batch), 0)
+                self.metrics.observe_request(rec)
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        gen_tokens = self.backend.count_tokens_batch(outs)
+        for r, out, n_out in zip(batch, outs, gen_tokens):
+            rec = self._record(r, "ok", t0, engine_s, len(batch), n_out)
+            self.metrics.observe_request(rec)
+            if not r.future.done():
+                r.future.set_result(_Completion(out, rec))
+
+    def _record(self, r, status, t0, engine_s, batch_size, gen_tokens):
+        now = time.monotonic()
+        return ServeRequestRecord(
+            request_id=r.request_id,
+            status=status,
+            queue_wait_s=max(t0 - r.enqueued_at, 0.0),
+            engine_s=engine_s,
+            total_s=max(now - r.enqueued_at, 0.0),
+            batch_size=batch_size,
+            prompt_tokens=r.est_tokens,
+            generated_tokens=gen_tokens,
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop admitting; drain=True runs remaining queued batches to
+        completion before the scheduler thread exits."""
+        self._closed = True
+        self.queue.close(drain=drain)
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():  # pragma: no cover - drain overrun
+            logger.warning("scheduler did not drain within %.1fs", timeout)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class QueuedBackend:
+    """Backend-protocol adapter over a MicroBatchScheduler.
+
+    generate() fans each prompt into its own queued request and blocks until
+    every future resolves, so a strategy's per-round batched call becomes N
+    coalescible units — two strategies running concurrently share engine
+    batches instead of serializing whole runs. Token counting delegates
+    straight to the real backend (host-side, thread-safe, no queue trip).
+
+    A RequestShed on any prompt of a round propagates to the caller: the
+    strategy run is aborted with the typed shed, matching the all-or-nothing
+    semantics a deadline implies. ``records`` accumulates the per-request
+    observability of every completed prompt for response-inline reporting.
+    """
+
+    name = "queued"
+
+    def __init__(self, scheduler: MicroBatchScheduler,
+                 deadline: float | None = None) -> None:
+        self.scheduler = scheduler
+        self.deadline = deadline
+        self.records: list[ServeRequestRecord] = []
+        self._lock = threading.Lock()
+
+    def generate(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+    ) -> list[str]:
+        if not prompts:
+            return []
+        # internal: this is the fan-out of an already-admitted request —
+        # its admission happened at the entry point (check_admission), so a
+        # wide strategy round must not shed itself against the depth budget
+        completions = self.scheduler.generate_sync(
+            prompts, max_new_tokens=max_new_tokens, config=config,
+            deadline=self.deadline, internal=True,
+        )
+        with self._lock:
+            self.records.extend(c.record for c in completions)
+        return [c.text for c in completions]
+
+    def count_tokens(self, text: str) -> int:
+        return self.scheduler.backend.count_tokens(text)
+
+    def count_tokens_batch(self, texts: list[str]) -> list[int]:
+        return self.scheduler.backend.count_tokens_batch(texts)

@@ -1,0 +1,416 @@
+"""Online serving HTTP front-end (stdlib, like the demo server — runs on
+TPU hosts with no extra packages).
+
+    python -m vnsum_tpu.serve.server --backend fake --port 8901
+    python -m vnsum_tpu.serve.server --backend tpu --model llama3.2:3b \
+        --max-batch 16 --max-wait-ms 10
+
+Endpoints:
+    POST /v1/summarize  {"text": ..., "approach": "mapreduce",
+                         "deadline_ms"?, "max_new_tokens"?}
+        Full strategy run. The strategy's rounds are submitted through the
+        micro-batching scheduler, so concurrent summarize requests share
+        engine batches.
+    POST /v1/generate   {"prompt": str} | {"prompts": [str, ...]},
+                        optional "max_new_tokens", "temperature", "top_k",
+                        "top_p", "seed", "deadline_ms"
+        Raw engine call(s) through the queue.
+    GET /healthz        liveness + queue depth
+    GET /metrics        Prometheus text (serve/metrics.py)
+
+Sheds (queue full, token budget, deadline, shutdown) return HTTP 429 with a
+typed JSON body {"error": "shed", "reason": "<queue_full|...>"} — the
+admission-control contract, machine-readable for client backoff.
+
+Each HTTP handler thread blocks on its request futures; ThreadingHTTPServer
+gives us one thread per in-flight request, and the scheduler coalesces
+across them. Strategy objects are constructed once per approach and reused
+across requests/threads — they are re-entrant by contract (all per-run
+state is local to summarize_batch; see strategies/base.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..backend.base import Backend, get_backend
+from ..core.config import APPROACHES, GenerationConfig, PipelineConfig, approach_defaults
+from ..core.logging import get_logger
+from ..strategies import get_strategy
+from ..text import clean_thinking_tokens
+from .queue import RequestShed
+from .scheduler import MicroBatchScheduler
+
+logger = get_logger("vnsum.serve.http")
+
+
+class ServeState:
+    """Everything the handler needs: the scheduler (which owns the engine)
+    plus a lazily-built per-approach strategy cache."""
+
+    def __init__(
+        self,
+        backend: Backend,
+        *,
+        max_batch: int = 8,
+        max_wait_s: float = 0.01,
+        max_queue_depth: int = 256,
+        max_queued_tokens: int = 0,
+        default_deadline_s: float | None = None,
+    ) -> None:
+        self.backend = backend
+        self.scheduler = MicroBatchScheduler(
+            backend,
+            max_batch=max_batch,
+            max_wait_s=max_wait_s,
+            max_queue_depth=max_queue_depth,
+            max_queued_tokens=max_queued_tokens,
+        )
+        self.default_deadline_s = default_deadline_s
+        self._strategies: dict[str, object] = {}
+        import threading
+
+        self._strategies_lock = threading.Lock()
+
+    def strategy_for(self, approach: str, max_new_tokens: int | None = None):
+        """ONE strategy instance per approach, shared across requests and
+        threads (the re-entrancy contract in strategies/base.py). It is
+        constructed against the RAW backend — splitters capture its
+        count_tokens, which must stay a direct host-side call — and each
+        request passes its own deadline-bound QueuedBackend via the
+        summarize(..., backend=) override, so generation rides the queue
+        while token counting does not. A per-request max_new_tokens
+        override bypasses the cache (the budget is baked in at
+        construction)."""
+        if max_new_tokens is not None:
+            cfg = PipelineConfig(
+                approach=approach,
+                **{**approach_defaults(approach),
+                   "max_new_tokens": int(max_new_tokens)},
+            )
+            return get_strategy(approach, self.backend, cfg)
+        with self._strategies_lock:
+            strat = self._strategies.get(approach)
+            if strat is None:
+                cfg = PipelineConfig(
+                    approach=approach, **approach_defaults(approach)
+                )
+                strat = get_strategy(approach, self.backend, cfg)
+                self._strategies[approach] = strat
+            return strat
+
+    def close(self) -> None:
+        self.scheduler.close(drain=True)
+
+
+class _BadRequest(ValueError):
+    """Client-side input error → HTTP 400, never the 500/engine-error path."""
+
+
+def _number(req: dict, key: str, cast, *, integer: bool = False):
+    val = req.get(key)
+    if val is None:
+        return None
+    if isinstance(val, bool) or not isinstance(val, (int, float)):
+        raise _BadRequest(f"{key!r} must be a number")
+    if integer and not float(val).is_integer():
+        raise _BadRequest(f"{key!r} must be an integer")
+    return cast(val)
+
+
+def _deadline_from(req: dict, default_s: float | None) -> float | None:
+    ms = _number(req, "deadline_ms", float)
+    if ms is not None:
+        return time.monotonic() + ms / 1000.0
+    if default_s is not None:
+        return time.monotonic() + default_s
+    return None
+
+
+def _gen_config_from(req: dict) -> GenerationConfig | None:
+    knobs = {}
+    for key, cast, integer in (
+        ("temperature", float, False),
+        ("top_k", int, True),
+        ("top_p", float, False),
+        ("seed", int, True),
+    ):
+        val = _number(req, key, cast, integer=integer)
+        if val is not None:
+            knobs[key] = val
+    if not knobs:
+        return None
+    return GenerationConfig(**knobs)
+
+
+def make_handler(state: ServeState):
+    class Handler(BaseHTTPRequestHandler):
+        # keep-alive: every response carries Content-Length, so persistent
+        # connections work — load generators and real clients reuse sockets
+        # instead of paying a TCP handshake per request
+        protocol_version = "HTTP/1.1"
+
+        def _json(self, payload: dict, status: int = 200) -> None:
+            body = json.dumps(payload, ensure_ascii=False).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _text(self, body: str, status: int = 200) -> None:
+            raw = body.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+            path = self.path.partition("?")[0]
+            if path == "/healthz":
+                self._json(
+                    {
+                        "status": "ok",
+                        "backend": state.backend.name,
+                        "queue_depth": state.scheduler.queue.depth,
+                        "queued_tokens": state.scheduler.queue.queued_tokens,
+                        "closed": state.scheduler.closed,
+                    }
+                )
+            elif path == "/metrics":
+                self._text(
+                    state.scheduler.metrics.render_prometheus(
+                        queue_depth=state.scheduler.queue.depth,
+                        queued_tokens=state.scheduler.queue.queued_tokens,
+                    )
+                )
+            else:
+                self._json({"error": "not found"}, 404)
+
+        # request bodies beyond this are refused outright: a huge (or
+        # negative, which would read to EOF and wedge the handler thread)
+        # Content-Length must not buffer unbounded bytes per connection
+        MAX_BODY_BYTES = 16 * 1024 * 1024
+
+        def _read_json(self) -> dict | None:
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                length = -1
+            if length < 0 or length > self.MAX_BODY_BYTES:
+                # refusing WITHOUT reading the body leaves its bytes in the
+                # stream — the next keep-alive request would parse as
+                # garbage, so drop the connection after responding
+                self.close_connection = True
+                if length < 0:
+                    self._json({"error": "bad Content-Length"}, 400)
+                else:
+                    self._json({"error": "request body too large"}, 413)
+                return None
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError:
+                self._json({"error": "invalid JSON"}, 400)
+                return None
+            if not isinstance(req, dict):
+                self._json({"error": "malformed request"}, 400)
+                return None
+            return req
+
+        def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+            path = self.path.partition("?")[0]
+            if path == "/v1/generate":
+                self._generate()
+            elif path == "/v1/summarize":
+                self._summarize()
+            else:
+                self._json({"error": "not found"}, 404)
+
+        def _generate(self) -> None:
+            req = self._read_json()
+            if req is None:
+                return
+            prompts = req.get("prompts")
+            if prompts is None:
+                prompt = req.get("prompt")
+                prompts = [prompt] if isinstance(prompt, str) else None
+            if not prompts or not all(isinstance(p, str) and p for p in prompts):
+                self._json({"error": "need 'prompt' or non-empty 'prompts'"}, 400)
+                return
+            try:
+                max_new_tokens = _number(req, "max_new_tokens", int, integer=True)
+                config = _gen_config_from(req)
+                deadline = _deadline_from(req, state.default_deadline_s)
+            except _BadRequest as e:
+                self._json({"error": str(e)}, 400)
+                return
+            try:
+                completions = state.scheduler.generate_sync(
+                    prompts,
+                    max_new_tokens=max_new_tokens,
+                    config=config,
+                    deadline=deadline,
+                )
+            except RequestShed as e:
+                self._json({"error": "shed", "reason": e.reason.value}, 429)
+                return
+            except Exception as e:  # engine failure: surface, don't crash
+                logger.exception("generate failed")
+                self._json({"error": str(e)}, 500)
+                return
+            self._json(
+                {
+                    "completions": [
+                        {"text": c.text, "record": c.record.to_dict()}
+                        for c in completions
+                    ]
+                }
+            )
+
+        def _summarize(self) -> None:
+            req = self._read_json()
+            if req is None:
+                return
+            text = req.get("text", "")
+            if not isinstance(text, str) or not text.strip():
+                self._json({"error": "empty document"}, 400)
+                return
+            approach = req.get("approach", "mapreduce")
+            if approach not in APPROACHES:
+                self._json(
+                    {"error": f"unknown approach {approach!r}",
+                     "approaches": list(APPROACHES)}, 400,
+                )
+                return
+            try:
+                max_new_tokens = _number(req, "max_new_tokens", int, integer=True)
+                deadline = _deadline_from(req, state.default_deadline_s)
+            except _BadRequest as e:
+                self._json({"error": str(e)}, 400)
+                return
+            qbackend = state.scheduler.backend_view(deadline=deadline)
+            t0 = time.monotonic()
+            try:
+                # request-level admission: the strategy's rounds fan out as
+                # INTERNAL submits that bypass the depth budget (a wide map
+                # round must not shed itself on an idle server), so the
+                # queue/token gate applies here, once, per request; the
+                # full-document tokenization is only worth paying when a
+                # token budget is actually configured
+                est_tokens = (
+                    state.backend.count_tokens(text)
+                    if state.scheduler.queue.max_queued_tokens
+                    else 0
+                )
+                state.scheduler.check_admission(est_tokens)
+                strategy = state.strategy_for(approach, max_new_tokens)
+                result = strategy.summarize(text, backend=qbackend)
+            except RequestShed as e:
+                self._json({"error": "shed", "reason": e.reason.value}, 429)
+                return
+            except Exception as e:
+                logger.exception("summarize failed")
+                self._json({"error": str(e)}, 500)
+                return
+            recs = qbackend.records
+            self._json(
+                {
+                    "approach": approach,
+                    "summary": clean_thinking_tokens(result.summary),
+                    "num_chunks": result.num_chunks,
+                    "llm_calls": result.llm_calls,
+                    "serving": {
+                        "llm_requests": len(recs),
+                        "queue_wait_s": round(sum(r.queue_wait_s for r in recs), 6),
+                        "engine_s": round(sum(r.engine_s for r in recs), 6),
+                        "generated_tokens": sum(r.generated_tokens for r in recs),
+                        "total_s": round(time.monotonic() - t0, 6),
+                    },
+                }
+            )
+
+        def log_message(self, fmt, *args):  # route through our logger
+            logger.info("%s %s", self.address_string(), fmt % args)
+
+    return Handler
+
+
+class _Server(ThreadingHTTPServer):
+    # socketserver's default listen backlog of 5 collapses under a connect
+    # burst (SYN retransmit backoff shows up as multi-second tail latency
+    # on clients that were never even admitted); a serving front-end wants
+    # the kernel queueing connects, not clients retransmitting
+    request_queue_size = 128
+    daemon_threads = True
+
+
+def make_server(
+    state: ServeState, host: str = "127.0.0.1", port: int = 8901
+) -> ThreadingHTTPServer:
+    return _Server((host, port), make_handler(state))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="vnsum-serve")
+    p.add_argument("--backend", choices=["tpu", "ollama", "hf", "fake"],
+                   default="fake")
+    p.add_argument("--model", default="llama3.2:3b")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8901)
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="engine batch ceiling per dispatch")
+    p.add_argument("--max-wait-ms", type=float, default=10.0,
+                   help="max time a head-of-line request waits for company")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="admission control: max queued requests")
+    p.add_argument("--max-queued-tokens", type=int, default=0,
+                   help="admission control: max queued prompt tokens (0=off)")
+    p.add_argument("--default-deadline-ms", type=float, default=None,
+                   help="deadline applied to requests that carry none")
+    args = p.parse_args(argv)
+
+    if args.backend == "tpu":
+        from ..models import MODEL_REGISTRY
+
+        backend = get_backend(
+            "tpu", model_config=MODEL_REGISTRY[args.model](),
+            batch_size=args.max_batch,
+        )
+    elif args.backend == "ollama":
+        backend = get_backend("ollama", model=args.model)
+    elif args.backend == "hf":
+        backend = get_backend("hf", model_name_or_path=args.model)
+    else:
+        backend = get_backend("fake")
+
+    state = ServeState(
+        backend,
+        max_batch=args.max_batch,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        max_queue_depth=args.max_queue,
+        max_queued_tokens=args.max_queued_tokens,
+        default_deadline_s=(
+            args.default_deadline_ms / 1000.0
+            if args.default_deadline_ms else None
+        ),
+    )
+    server = make_server(state, args.host, args.port)
+    logger.info(
+        "serving on http://%s:%d/ (backend=%s max_batch=%d max_wait=%.0fms)",
+        args.host, args.port, backend.name, args.max_batch, args.max_wait_ms,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        state.close()  # drain the queue before exiting
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,8 +41,10 @@ class TruncatedStrategy:
             text = self.tok.decode(ids[:limit])
         return text
 
-    def summarize_batch(self, docs: list[str]) -> list[StrategyResult]:
-        gen = _BatchCounter(self.backend, self.max_new_tokens)
+    def summarize_batch(
+        self, docs: list[str], *, backend: Backend | None = None
+    ) -> list[StrategyResult]:
+        gen = _BatchCounter(backend or self.backend, self.max_new_tokens)
         prompts = [TRUNCATED.format(text=self._truncate(d)) for d in docs]
         outs = gen(prompts, owners=list(range(len(docs))))
         return [
@@ -50,5 +52,5 @@ class TruncatedStrategy:
             for o in outs
         ]
 
-    def summarize(self, doc: str) -> StrategyResult:
-        return self.summarize_batch([doc])[0]
+    def summarize(self, doc: str, *, backend: Backend | None = None) -> StrategyResult:
+        return self.summarize_batch([doc], backend=backend)[0]
