@@ -282,3 +282,116 @@ def test_prefill_kernel_int8_cache_bf16_queries_close_to_f32():
             np.asarray(flash_bf16, np.float32)[b, int(pad[b]):],
             rtol=0.05, atol=0.05,
         )
+
+
+# -- multi-position verify kernel (speculative decoding) ---------------------
+
+
+def make_verify_case(L, B, KV, C, Sq, H, hd, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(kq, (B, Sq, H, hd), jnp.float32)
+    k_all = jax.random.normal(kk, (L, B, KV, C, hd), jnp.float32)
+    v_all = jax.random.normal(kv, (L, B, KV, C, hd), jnp.float32)
+    return q, {"k": k_all, "v": v_all}
+
+
+@pytest.mark.parametrize("layer", [0, 2])
+@pytest.mark.parametrize(
+    "fills,pads", [([10, 40], [0, 5]), ([58, 12], [3, 0]), ([7, 7], [2, 2])]
+)
+def test_verify_kernel_matches_dense(layer, fills, pads):
+    """flash_spec_verify_attention vs _attention under the verify mask:
+    per-row fills, multiple query positions per row."""
+    from vnsum_tpu.models.llama import verify_attention_mask
+    from vnsum_tpu.ops.decode_attention import flash_spec_verify_attention
+
+    L, B, KV, C, Sq, H, hd = 3, 2, 2, 64, 5, 4, 128
+    q, cache = make_verify_case(L, B, KV, C, Sq, H, hd, seed=layer)
+    pad = jnp.asarray(pads, jnp.int32)
+    fill = jnp.asarray(fills, jnp.int32)
+
+    mask = verify_attention_mask(pad, fill, Sq, C)
+    dense = _attention(q, cache["k"][layer], cache["v"][layer], mask, H // KV)
+    kernel = flash_spec_verify_attention(
+        q, cache, layer, pad, fill, H // KV, block_k=16, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(kernel), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_verify_kernel_ignores_beyond_limit_garbage():
+    """Slots past each row's per-query limit must not leak in — including
+    slots BETWEEN two rows' differing fills (the rollback region)."""
+    from vnsum_tpu.ops.decode_attention import flash_spec_verify_attention
+
+    L, B, KV, C, Sq, H, hd = 1, 2, 1, 32, 3, 2, 128
+    q, cache = make_verify_case(L, B, KV, C, Sq, H, hd, seed=9)
+    fills = jnp.asarray([6, 20], jnp.int32)
+    pad = jnp.zeros((B,), jnp.int32)
+    # poison row 0 beyond ITS visibility (limit 6+3-1=8) but inside row 1's
+    poisoned = {
+        "k": cache["k"].at[:, 0, :, 9:, :].set(30.0),
+        "v": cache["v"].at[:, 0, :, 9:, :].set(1e9),
+    }
+    clean = flash_spec_verify_attention(
+        q, cache, 0, pad, fills, H // KV, block_k=8, interpret=True
+    )
+    dirty = flash_spec_verify_attention(
+        q, poisoned, 0, pad, fills, H // KV, block_k=8, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(clean)[0], np.asarray(dirty)[0]
+    )
+
+
+def test_verify_kernel_int8_cache_matches_dequantized_dense():
+    from vnsum_tpu.models.llama import (
+        _quantize_kv,
+        dequantize_cache_layer,
+        verify_attention_mask,
+    )
+    from vnsum_tpu.ops.decode_attention import flash_spec_verify_attention
+
+    L, B, KV, C, Sq, H, hd = 2, 2, 2, 64, 4, 4, 128
+    q, cache = make_verify_case(L, B, KV, C, Sq, H, hd, seed=3)
+    k8, ks = jax.vmap(_quantize_kv)(cache["k"])
+    v8, vs = jax.vmap(_quantize_kv)(cache["v"])
+    qcache = {"k": k8, "v": v8, "ks": ks, "vs": vs}
+    pad = jnp.asarray([0, 4], jnp.int32)
+    fills = jnp.asarray([30, 55], jnp.int32)
+
+    kd, vd = dequantize_cache_layer(qcache, 1)
+    mask = verify_attention_mask(pad, fills, Sq, C)
+    dense = _attention(q, kd.astype(q.dtype), vd.astype(q.dtype), mask, H // KV)
+    kernel = flash_spec_verify_attention(
+        q, qcache, 1, pad, fills, H // KV, block_k=16, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(kernel), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("win", [4, 16])
+def test_verify_kernel_windowed_matches_dense(win):
+    """Sliding-window verify: per-query window floor (fill_b + s - win)."""
+    from vnsum_tpu.models.llama import verify_attention_mask
+    from vnsum_tpu.ops.decode_attention import flash_spec_verify_attention
+
+    L, B, KV, C, Sq, H, hd = 1, 2, 2, 64, 3, 4, 128
+    q, cache = make_verify_case(L, B, KV, C, Sq, H, hd, seed=5)
+    pad = jnp.asarray([0, 2], jnp.int32)
+    fills = jnp.asarray([20, 44], jnp.int32)
+
+    limit = fills[:, None] + jnp.arange(Sq)[None, :]
+    mask = verify_attention_mask(pad, fills, Sq, C) & (
+        jnp.arange(C)[None, None, :] > (limit[:, :, None] - win)
+    )
+    dense = _attention(q, cache["k"][0], cache["v"][0], mask, H // KV)
+    kernel = flash_spec_verify_attention(
+        q, cache, 0, pad, fills, H // KV, window=jnp.int32(win),
+        block_k=16, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(kernel), rtol=2e-5, atol=2e-5
+    )

@@ -24,8 +24,16 @@ class Backend(Protocol):
         *,
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
+        references: list[str | None] | None = None,
     ) -> list[str]:
-        """Generate one completion per prompt, order-preserving."""
+        """Generate one completion per prompt, order-preserving.
+
+        ``references`` optionally carries one source text per prompt (None
+        entries allowed) for reference-guided speculative decoding
+        (vnsum_tpu.spec): strategies pass the chunk being summarized, and a
+        backend with ``config.spec_k > 0`` drafts from it. Backends without
+        speculation accept and ignore it — it is advisory metadata, never a
+        semantic input."""
         ...
 
     def count_tokens(self, text: str) -> int:

@@ -45,8 +45,10 @@ from ..models.llama import (
     llama32_3b,
     prefill_attention_mask,
     prefill_positions,
+    verify_attention_mask,
+    verify_positions,
 )
-from ..models.sampling import sample_logits_rows
+from ..models.sampling import draft_acceptance_rows, sample_logits_rows
 from ..text.tokenizer import Tokenizer, get_tokenizer
 
 logger = get_logger("vnsum.engine")
@@ -72,6 +74,13 @@ class EngineStats:
     compile_seconds: float = 0.0
     generate_seconds: float = 0.0
     batches: int = 0
+    # speculative decoding (spec path): batched verify forwards run, draft
+    # tokens proposed to them, and draft tokens the model kept. Mean
+    # accepted-per-step = spec_accepted_tokens / spec_verify_steps; every
+    # step additionally retires one model-own token.
+    spec_verify_steps: int = 0
+    spec_draft_tokens: int = 0
+    spec_accepted_tokens: int = 0
     compactions: int = 0
     compacted_batch_sizes: list = field(default_factory=list)
     by_bucket: dict = field(default_factory=dict)
@@ -117,6 +126,7 @@ class TpuBackend:
         interpret: bool = False,
         instrument: bool = False,
         prefill_chunk_tokens: int = 0,
+        spec_max_ref_tokens: int = 4096,
     ) -> None:
         from ..core.jax_cache import enable_compilation_cache
 
@@ -228,6 +238,12 @@ class TpuBackend:
         self._compact_fn = None
         self._seed = seed
         self._dispatch = 0
+        # reference-guided speculative decoding (vnsum_tpu.spec): cap on
+        # tokens encoded per reference (matching window, not attention — a
+        # longer reference only loses tail draft coverage)
+        self.spec_max_ref_tokens = int(spec_max_ref_tokens)
+        self._spec_report: list = []
+        self._warned_spec_fallback = False
 
         if params is None:
             t0 = time.time()
@@ -252,6 +268,25 @@ class TpuBackend:
 
     # -- compiled program per bucket ------------------------------------
 
+    def _sampling_setup(self, gen: GenerationConfig):
+        """(eos ids, vocab limit, restrict fn) — the ONE sampling restriction
+        shared by the plain decode programs (_make_parts) and the spec verify
+        step, so the two paths can never disagree on what is sampleable.
+        Never sample a token the tokenizer cannot render as text — but keep
+        every terminator sampleable even when it sits above the decodable
+        range (ByteTokenizer's eos_id=257 >= 256 raw bytes)."""
+        terminators = terminator_ids(self.tok, gen)
+        eos = jnp.asarray(terminators, dtype=jnp.int32)
+        vocab_limit, allowed = sampling_vocab(
+            self.tok, self.cfg.vocab_size, terminators
+        )
+        allowed_dev = None if allowed is None else jnp.asarray(allowed)
+
+        def restrict(row_logits):  # [..., vocab_limit]
+            return mask_unsampleable(row_logits, allowed_dev)
+
+        return eos, vocab_limit, restrict
+
     def _make_parts(self, B: int, S: int, max_new: int, gen: GenerationConfig):
         """The two traceable halves every generation program is composed of:
 
@@ -273,19 +308,7 @@ class TpuBackend:
         cannot drift."""
         cfg = self.cfg
         C = S + max_new
-        terminators = terminator_ids(self.tok, gen)
-        eos = jnp.asarray(terminators, dtype=jnp.int32)
-        # never sample a token the tokenizer cannot render as text — but
-        # keep every terminator sampleable even when it sits above the
-        # decodable range (ByteTokenizer's eos_id=257 >= 256 raw bytes)
-        vocab_limit, allowed = sampling_vocab(
-            self.tok, cfg.vocab_size, terminators
-        )
-        allowed_dev = None if allowed is None else jnp.asarray(allowed)
-
-        def restrict(row_logits):  # [B, vocab_limit]
-            return mask_unsampleable(row_logits, allowed_dev)
-
+        eos, vocab_limit, restrict = self._sampling_setup(gen)
         pad_id = self.tok.pad_id
         use_flash, use_flash_decode = self._decode_settings(S, C)
         mesh = self.mesh
@@ -829,6 +852,223 @@ class TpuBackend:
             if orig is not None and results[orig] is None:
                 results[orig] = self._detok(out_h[r], tuple(gen.eos_ids))
 
+    # -- speculative decoding (reference-guided, vnsum_tpu.spec) ---------
+
+    def _make_spec_fn(self, B: int, S: int, R: int, max_new: int, k: int,
+                      gen: GenerationConfig):
+        """One jitted speculative step: draft (n-gram suffix match against
+        the per-row reference), verify (ONE forward over k+1 query positions
+        per row against the KV cache), accept (exact argmax prefix for
+        greedy, rejection-style for sampling — models.sampling), emit.
+
+        Per-row state raggedness is the defining difference from decode_part:
+        rows accept different draft counts, so fills/emitted counts are [B]
+        vectors, cache writes land at per-row slots (llama._cache_write),
+        and rejected tokens "roll back" by simply not advancing the row's
+        fill — the stale slots sit beyond every mask and are overwritten by
+        the next step's write at that row's true fill.
+
+        Cache/out geometry: C = S + max_new + k + 1 and the out buffer is
+        max_new + k + 1 wide, so a step entered at e = max_new - 1 (or a
+        done row parked at e = max_new) can always write its fixed-shape
+        k+1 tokens without dynamic_update_slice's start-clamp silently
+        shifting the write onto valid earlier slots."""
+        from ..spec import NO_TOKEN, propose_drafts
+
+        cfg = self.cfg
+        k1 = k + 1
+        C = S + max_new + k1
+        N = max(gen.spec_ngram, 1)
+        eos, vocab_limit, restrict = self._sampling_setup(gen)
+        pad_id = self.tok.pad_id
+        _, use_flash_decode = self._decode_settings(S, C)
+        # the multi-position Pallas kernel is single-chip; under a mesh the
+        # dense per-row path still works (generate() currently prefers the
+        # plain decode program there — see the fallback in generate())
+        use_verify_kernel = use_flash_decode and self.mesh is None
+        interpret = self.interpret
+        layer_window = self._layer_window_fn()
+
+        def spec_step(params, cur, cache, done, e, out, pads, ref,
+                      ref_lens, seed):
+            base = jax.random.key(seed)
+            uids = jnp.arange(B, dtype=jnp.int32)
+            fills = S + e                                       # [B]
+
+            # --- draft: last N emitted tokens (incl. cur) vs reference ---
+            if N > 1:
+                out_pad = jnp.concatenate(
+                    [jnp.full((B, N - 1), NO_TOKEN, jnp.int32), out], axis=1
+                )
+                hist = jax.vmap(
+                    lambda row, s: jax.lax.dynamic_slice(row, (s,), (N - 1,))
+                )(out_pad, e)
+                tail = jnp.concatenate([hist, cur[:, None]], axis=1)
+            else:
+                tail = cur[:, None]
+            drafts, n_draft = propose_drafts(ref, ref_lens, tail, k)
+            # done rows draft nothing; live rows never draft past the token
+            # budget (acceptance may not push e beyond max_new)
+            n_draft = jnp.where(done, 0, n_draft)
+            n_draft = jnp.minimum(n_draft, jnp.maximum(max_new - e - 1, 0))
+
+            # --- batched verify forward over k+1 positions per row ---
+            toks = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, k1]
+            positions = verify_positions(pads, fills, k1)
+            mask = verify_attention_mask(pads, fills, k1, C)
+            stacked_fn = None
+            if use_verify_kernel:
+                from ..ops.decode_attention import flash_spec_verify_attention
+
+                def stacked_fn(q, cache_d, layer_idx):
+                    return flash_spec_verify_attention(
+                        q, cache_d, layer_idx, pads, fills, cfg.q_per_kv,
+                        layer_window(layer_idx), interpret=interpret,
+                    )
+
+            logits, cache = forward(
+                params, cfg, toks, positions, cache, fills, mask,
+                stacked_attention_fn=stacked_fn,
+            )
+            logits = restrict(logits[:, :, :vocab_limit])
+
+            # --- accept + emit ---
+            # position i (when reached) emits stream token e + i: key on
+            # that absolute position so acceptance raggedness never replays
+            # a row's randomness
+            pos_ids = e[:, None] + jnp.arange(k1, dtype=jnp.int32)[None, :] + 1
+            keys = jax.vmap(
+                lambda u, ps: jax.vmap(
+                    lambda p: jax.random.fold_in(jax.random.fold_in(base, u), p)
+                )(ps)
+            )(uids, pos_ids)
+            m, nxt = draft_acceptance_rows(
+                logits, drafts, n_draft, keys,
+                gen.temperature, gen.top_k, gen.top_p,
+            )
+
+            idx = jnp.arange(k1, dtype=jnp.int32)[None, :]
+            is_term = jnp.isin(toks, eos)
+            no_term_before = jnp.cumprod(
+                jnp.concatenate(
+                    [jnp.ones((B, 1), jnp.int32),
+                     (~is_term[:, :-1]).astype(jnp.int32)],
+                    axis=1,
+                ),
+                axis=1,
+            ).astype(bool)
+            # emit cur plus accepted drafts, cut just after a terminator —
+            # the terminator itself is emitted (and detok-stripped) exactly
+            # like the plain decode path's emit-before-done-check
+            valid = (idx <= m[:, None]) & no_term_before & ~done[:, None]
+            emit = jnp.where(valid, toks, pad_id)
+            out = jax.vmap(
+                lambda o, v, s: jax.lax.dynamic_update_slice(o, v, (s,))
+            )(out, emit, e)
+            n_emit = valid.sum(axis=1).astype(jnp.int32)
+            e_new = e + n_emit
+            done_new = done | (is_term & valid).any(axis=1) | (e_new >= max_new)
+            cur_new = jnp.where(done, cur, nxt)
+            accepted = jnp.maximum(n_emit - 1, 0)
+            return cur_new, cache, done_new, e_new, out, n_draft, accepted
+
+        return jax.jit(spec_step, donate_argnums=(2, 5))
+
+    def _get_spec_fn(self, B, S, R, max_new, k, gen):
+        key = ("spec", B, S, R, max_new, k, gen.with_(seed=0))
+        if key not in self._fns:
+            t0 = time.time()
+            self._fns[key] = self._make_spec_fn(B, S, R, max_new, k, gen)
+            logger.info(
+                "built spec fn for bucket B=%d S=%d R=%d k=%d", B, S, R, k
+            )
+            self.stats.compile_seconds += time.time() - t0
+        return self._fns[key]
+
+    def _run_group_spec(
+        self, group, encoded, references, max_new: int, gen, results,
+        report, seed: int,
+    ) -> None:
+        """Generate one prompt group with reference-guided speculation:
+        shared prefill, then a host loop of jitted spec steps (draft →
+        batched verify → accept). Every step retires >= 1 token per live
+        row, so the loop is bounded by max_new; rows whose reference never
+        matches degrade to exactly one token per step."""
+        from ..spec import NO_TOKEN, SpecRecord, encode_references
+
+        k = gen.spec_k
+        tokens, pads, B, S = self._pack_group(group, encoded, max_new)
+
+        # per-row reference buffers, R bucketed to a power of two so ref
+        # length variation doesn't fan out fresh XLA programs
+        refs_group = [references[i] if references else None for i in group]
+        ref_np, ref_lens_np = encode_references(
+            self.tok, refs_group, self.spec_max_ref_tokens
+        )
+        R = 64
+        while R < ref_np.shape[1]:
+            R *= 2
+        ref_full = np.full((B, R), NO_TOKEN, dtype=np.int32)
+        ref_full[: len(group), : ref_np.shape[1]] = ref_np
+        lens_full = np.zeros((B,), dtype=np.int32)
+        lens_full[: len(group)] = ref_lens_np
+
+        prefill = self._get_seg_fn("prefill", B, S, max_new + k + 1, gen)
+        t_pre = time.time()
+        with annotate(f"spec_prefill[B={B},S={S}]"):
+            cur, cache, done = prefill(self.params, tokens, pads, seed)
+        if self.instrument:
+            np.asarray(done)
+            self.stats.add_phase("prefill", time.time() - t_pre)
+        self.stats.batches += 1
+        self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
+
+        fn = self._get_spec_fn(B, S, R, max_new, k, gen)
+        pad_dev = jnp.asarray(pads)
+        ref_dev = jnp.asarray(ref_full)
+        lens_dev = jnp.asarray(lens_full)
+        out = jnp.full((B, max_new + k + 1), self.tok.pad_id, dtype=jnp.int32)
+        e = jnp.zeros((B,), dtype=jnp.int32)
+
+        drafted = np.zeros((B,), dtype=np.int64)
+        accepted = np.zeros((B,), dtype=np.int64)
+        steps_live = np.zeros((B,), dtype=np.int64)
+        prev_done = np.asarray(done)
+        t_dec = time.time()
+        while not prev_done.all():
+            with annotate(f"spec_step[B={B},S={S},k={k}]"):
+                cur, cache, done, e, out, nd, acc = fn(
+                    self.params, cur, cache, done, e, out, pad_dev,
+                    ref_dev, lens_dev, seed,
+                )
+            steps_live += ~prev_done
+            drafted += np.asarray(nd)
+            accepted += np.asarray(acc)
+            self.stats.spec_verify_steps += 1
+            prev_done = np.asarray(done)
+        if self.instrument:
+            self.stats.add_phase("spec_decode", time.time() - t_dec)
+        self.stats.spec_draft_tokens += int(drafted[: len(group)].sum())
+        self.stats.spec_accepted_tokens += int(accepted[: len(group)].sum())
+
+        out_h = np.asarray(out)[:, :max_new]
+        for row, i in enumerate(group):
+            results[i] = self._detok(out_h[row], tuple(gen.eos_ids))
+            report[i] = SpecRecord(
+                draft_tokens=int(drafted[row]),
+                accepted_tokens=int(accepted[row]),
+                verify_steps=int(steps_live[row]),
+            )
+
+    def take_spec_report(self):
+        """Per-prompt SpecRecords of the LAST generate call, aligned with
+        its prompt order (empty when speculation was off), cleared on read.
+        The serving scheduler attributes per-request acceptance metrics
+        through this hook; engine access is single-threaded by the serving
+        contract (serve/scheduler.py), so read-after-generate is safe."""
+        report, self._spec_report = self._spec_report, []
+        return report
+
     # -- public API ------------------------------------------------------
 
     def _pack_group(self, group, encoded, max_new: int):
@@ -858,6 +1098,7 @@ class TpuBackend:
         *,
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
+        references: list[str | None] | None = None,
     ) -> list[str]:
         gen = config or self.gen_cfg
         max_new = resolve_max_new(max_new_tokens, gen, self.max_new_tokens)
@@ -867,6 +1108,34 @@ class TpuBackend:
             )
         if not prompts:
             return []
+        if references is not None and len(references) != len(prompts):
+            raise ValueError(
+                f"references must align with prompts: got {len(references)} "
+                f"for {len(prompts)}"
+            )
+
+        # reference-guided speculative decoding: needs spec_k > 0 AND at
+        # least one reference to draft from. The multi-position verify path
+        # is single-chip for now — under a mesh, degrade to plain decode
+        # (same outputs in greedy, just one token per step) instead of
+        # failing the request.
+        spec_on = (
+            gen.spec_k > 0
+            and references is not None
+            and any(references)
+        )
+        if spec_on and self.mesh is not None:
+            if not self._warned_spec_fallback:
+                self._warned_spec_fallback = True
+                logger.warning(
+                    "spec_k=%d requested under a mesh; speculative decoding "
+                    "is single-chip — falling back to plain decode",
+                    gen.spec_k,
+                )
+            spec_on = False
+        spec_report: list = (
+            [None] * len(prompts) if spec_on else []
+        )
 
         self.stats.calls += 1
         self.stats.prompts += len(prompts)
@@ -898,6 +1167,18 @@ class TpuBackend:
         for start in range(0, len(order), self.batch_size):
             group = order[start : start + self.batch_size]
             seed = self._next_seed(gen)
+            # per-GROUP spec routing: a coalesced batch can mix referenced
+            # and reference-less requests, and length-sorting may put all
+            # the refless ones in one group — that group would pay the
+            # (k+1)-wide verify forward to retire one token per step, so it
+            # takes the plain path instead (identical greedy output either
+            # way; its spec_report rows stay zero)
+            if spec_on and any(references[i] for i in group):
+                self._run_group_spec(
+                    group, encoded, references, max_new, gen, results,
+                    spec_report, seed,
+                )
+                continue
             if continuous:
                 self._run_group_continuous(
                     group, encoded, max_new, gen, results, seed
@@ -912,6 +1193,14 @@ class TpuBackend:
             for row, i in enumerate(group):
                 results[i] = self._detok(out[row], tuple(gen.eos_ids))
         self.stats.generate_seconds += time.time() - t0
+        if spec_on:
+            from ..spec import SpecRecord
+
+            # rows whose group took the plain path report zeros, keeping
+            # the per-prompt alignment the serving scheduler relies on
+            spec_report = [r if r is not None else SpecRecord()
+                           for r in spec_report]
+        self._spec_report = spec_report
         return results  # type: ignore[return-value]
 
     def _detok(self, ids: np.ndarray, extra_eos: tuple[int, ...] = ()) -> str:

@@ -14,6 +14,14 @@ win. The serving scheduler tests and scripts/bench_serving.py use it to
 measure batching effects hermetically; it defaults off so every existing
 test is unchanged. ``batch_sizes`` records the prompt count of each call
 (``calls`` flattens prompts, which hides batch boundaries).
+
+Speculative-decoding plumbing (vnsum_tpu.spec) is mirrored synthetically:
+``generate`` accepts per-prompt ``references`` (recorded in
+``references_seen``), and when speculation is requested (``config.spec_k``
+> 0, or the constructor's ``spec_k``) each prompt gets a deterministic
+SpecRecord at the configured ``spec_acceptance`` rate, retrievable once via
+``take_spec_report()`` — the same contract TpuBackend exposes — so serve
+and strategy tests can exercise acceptance-rate metrics without a model.
 """
 from __future__ import annotations
 
@@ -21,6 +29,7 @@ import re
 import time
 
 from ..core.config import GenerationConfig
+from ..spec import SpecRecord
 from ..text.tokenizer import whitespace_token_count
 
 _BLOCK = re.compile(
@@ -39,14 +48,22 @@ class FakeBackend:
         prefix: str = "",
         batch_overhead_s: float = 0.0,
         per_prompt_s: float = 0.0,
+        spec_k: int = 0,
+        spec_acceptance: float = 0.5,
     ) -> None:
         self._responses = list(responses) if responses else None
         self.summary_words = summary_words
         self.prefix = prefix
         self.batch_overhead_s = batch_overhead_s
         self.per_prompt_s = per_prompt_s
+        # default spec_k applied when a call's config doesn't carry one —
+        # mirrors TpuBackend's generation=GenerationConfig(spec_k=...)
+        self.spec_k = spec_k
+        self.spec_acceptance = spec_acceptance
         self.calls: list[str] = []
         self.batch_sizes: list[int] = []
+        self.references_seen: list[str | None] = []
+        self._spec_report: list[SpecRecord] = []
 
     def _one(self, prompt: str) -> str:
         if self._responses is not None:
@@ -64,12 +81,41 @@ class FakeBackend:
         *,
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
+        references: list[str | None] | None = None,
     ) -> list[str]:
         self.calls.extend(prompts)
         self.batch_sizes.append(len(prompts))
+        self.references_seen.extend(
+            references if references is not None else [None] * len(prompts)
+        )
         if self.batch_overhead_s or self.per_prompt_s:
             time.sleep(self.batch_overhead_s + self.per_prompt_s * len(prompts))
-        return [self._one(p) for p in prompts]
+        outs = [self._one(p) for p in prompts]
+        k = config.spec_k if config is not None else self.spec_k
+        self._spec_report = [
+            self._synthetic_spec(k, references[i] if references else None, o)
+            for i, o in enumerate(outs)
+        ] if k > 0 else []
+        return outs
+
+    def _synthetic_spec(self, k: int, reference, out: str) -> SpecRecord:
+        """Deterministic per-prompt stats: a row with a reference drafts k
+        per step and keeps spec_acceptance of them; one with no reference
+        drafts nothing (matching the real drafter's degradation)."""
+        steps = max(len(out.split()), 1)
+        drafted = k * steps if reference else 0
+        return SpecRecord(
+            draft_tokens=drafted,
+            accepted_tokens=int(drafted * self.spec_acceptance),
+            verify_steps=steps,
+        )
+
+    def take_spec_report(self) -> list[SpecRecord]:
+        """Per-prompt SpecRecords of the LAST generate call (empty when
+        speculation was off), cleared on read — the backend-optional hook
+        the serving scheduler attributes acceptance metrics through."""
+        report, self._spec_report = self._spec_report, []
+        return report
 
     def count_tokens(self, text: str) -> int:
         return whitespace_token_count(text)

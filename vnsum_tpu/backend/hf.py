@@ -91,6 +91,7 @@ class HFBackend:
         *,
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
+        references: list[str | None] | None = None,  # spec metadata; unused
     ) -> list[str]:
         torch = self._torch
         max_new = resolve_max_new(max_new_tokens, config, self.max_new_tokens)

@@ -134,6 +134,7 @@ class OllamaBackend:
         *,
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
+        references: list[str | None] | None = None,  # spec metadata; unused
     ) -> list[str]:
         max_new = resolve_max_new(max_new_tokens, config, self.max_new_tokens)
         if len(prompts) == 1:

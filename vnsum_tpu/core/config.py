@@ -41,6 +41,17 @@ class GenerationConfig:
     top_p: float = 1.0
     eos_ids: tuple[int, ...] = ()
     seed: int = 0
+    # reference-guided speculative decoding (vnsum_tpu.spec): propose up to
+    # spec_k continuation tokens per row by n-gram matching the emitted
+    # stream against the request's reference text (backend.generate's
+    # per-prompt `references`), verified in one batched forward. 0 = off —
+    # the default engine decode path is untouched and outputs are
+    # bit-identical to pre-spec builds. Greedy outputs are identical at ANY
+    # spec_k (acceptance is exact argmax prefix match); sampling stays
+    # distribution-lossless but consumes randomness differently.
+    spec_k: int = 0
+    # longest emitted-stream suffix the drafter tries to match (>=1)
+    spec_ngram: int = 3
 
     def with_(self, **kw) -> "GenerationConfig":
         return dataclasses.replace(self, **kw)

@@ -89,9 +89,20 @@ class ServeRequestRecord:
     batch_size: int = 0        # occupancy of the engine batch it rode
     prompt_tokens: int = 0
     generated_tokens: int = 0
+    # reference-guided speculative decoding (vnsum_tpu.spec): per-request
+    # drafting/acceptance, attributed from the backend's take_spec_report
+    # hook (all zero when speculation was off for the batch)
+    draft_tokens: int = 0
+    accepted_tokens: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted_tokens / self.draft_tokens if self.draft_tokens else 0.0
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["acceptance_rate"] = round(self.acceptance_rate, 6)
+        return d
 
 
 @dataclass
@@ -109,10 +120,17 @@ class ServingStats:
     queue_wait_seconds: float = 0.0
     prompt_tokens: int = 0
     generated_tokens: int = 0
+    # speculative decoding aggregates (sums of the per-request fields)
+    draft_tokens: int = 0
+    accepted_tokens: int = 0
 
     @property
     def shed_total(self) -> int:
         return sum(self.shed.values())
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted_tokens / self.draft_tokens if self.draft_tokens else 0.0
 
     @property
     def avg_batch_occupancy(self) -> float:
@@ -128,6 +146,7 @@ class ServingStats:
         d["shed_total"] = self.shed_total
         d["avg_batch_occupancy"] = self.avg_batch_occupancy
         d["tokens_per_second"] = self.tokens_per_second
+        d["acceptance_rate"] = round(self.acceptance_rate, 6)
         return d
 
 

@@ -397,6 +397,29 @@ def _attention(
     return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd)
 
 
+def _cache_write(buf, val, layer_idx, write_index):
+    """Write a per-layer K/V (or scale) slab into the stacked cache.
+
+    ``buf`` [L, B, KV, C(, hd)], ``val`` [B, KV, S(, hd)]. ``write_index``
+    is the cache slot of val's first token — a scalar (prefill/decode: every
+    row writes at the same slot) or a [B] vector (the speculative verify
+    step: rows sit at different fills after ragged draft acceptance, so each
+    row writes at its own slot via a vmapped per-row update)."""
+    tail = (0,) * (buf.ndim - 4)  # hd present on k/v, absent on ks/vs
+    if jnp.ndim(write_index) == 0:
+        return jax.lax.dynamic_update_slice(
+            buf, val[None], (layer_idx, 0, 0, write_index) + tail
+        )
+    return jax.vmap(
+        # per row: buf slice [L, KV, C(, hd)], update [1, KV, S(, hd)]
+        lambda c, u, w: jax.lax.dynamic_update_slice(
+            c, u[None], (layer_idx, 0, w) + tail
+        ),
+        in_axes=(1, 0, 0),
+        out_axes=1,
+    )(buf, val, write_index)
+
+
 def _block(
     x, lp, layer_idx, rope, mask, is_global, cache, write_index,
     cfg: LlamaConfig, attention_fn=None, stacked_attention_fn=None,
@@ -409,7 +432,8 @@ def _block(
     scan carry). Carrying the whole cache and writing the small slice keeps
     decode HBM traffic at weights+cache-read — emitting per-layer caches as
     scan outputs would re-materialize the whole ~GB cache every decode
-    step."""
+    step. ``write_index`` may be a [B] vector (see _cache_write) for the
+    speculative verify step's per-row fills."""
     P1 = cfg.norm_plus_one
     cos, sin = rope[0]
     if cfg.sliding_window:
@@ -422,17 +446,27 @@ def _block(
         sin = jnp.where(is_global, sin, sin_l)
         C = mask.shape[-1]
         S = x.shape[1]
-        q_slot = write_index + jnp.arange(S)
         k_slot = jnp.arange(C)
-        in_window = (
-            k_slot[None, :] > q_slot[:, None] - cfg.sliding_window
-        )[None]
+        if jnp.ndim(write_index) == 0:
+            q_slot = write_index + jnp.arange(S)
+            in_window = (
+                k_slot[None, :] > q_slot[:, None] - cfg.sliding_window
+            )[None]
+        else:  # per-row write slots (spec verify): [B, S] query slots
+            q_slot = write_index[:, None] + jnp.arange(S)[None, :]
+            in_window = (
+                k_slot[None, None, :]
+                > q_slot[:, :, None] - cfg.sliding_window
+            )
         mask = mask & (is_global | in_window)
 
     # W8A8 only on MULTI-token forwards (prefill): decode's single-token
     # matmuls are HBM-bound and S is trace-static, so this gate adds no
-    # device control flow
-    aq = cfg.w8a8_prefill and x.shape[1] > 1
+    # device control flow. The spec VERIFY forward is multi-token but
+    # decode-phase (per-row write_index is its signature): it must stay
+    # exact — speculation promises greedy outputs identical to plain
+    # decode, and plain decode scores these positions unquantized
+    aq = cfg.w8a8_prefill and x.shape[1] > 1 and jnp.ndim(write_index) == 0
     h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps, P1)
     q = _proj("bsd,dhk->bshk", h, lp["wq"], aq)
     k = _proj("bsd,dhk->bshk", h, lp["wk"], aq)
@@ -458,28 +492,16 @@ def _block(
         v8, vs = _quantize_kv(vt)
         cache = dict(
             cache,
-            k=jax.lax.dynamic_update_slice(
-                cache["k"], k8[None], (layer_idx, 0, 0, write_index, 0)
-            ),
-            v=jax.lax.dynamic_update_slice(
-                cache["v"], v8[None], (layer_idx, 0, 0, write_index, 0)
-            ),
-            ks=jax.lax.dynamic_update_slice(
-                cache["ks"], ks[None], (layer_idx, 0, 0, write_index)
-            ),
-            vs=jax.lax.dynamic_update_slice(
-                cache["vs"], vs[None], (layer_idx, 0, 0, write_index)
-            ),
+            k=_cache_write(cache["k"], k8, layer_idx, write_index),
+            v=_cache_write(cache["v"], v8, layer_idx, write_index),
+            ks=_cache_write(cache["ks"], ks, layer_idx, write_index),
+            vs=_cache_write(cache["vs"], vs, layer_idx, write_index),
         )
     else:
         cache = dict(
             cache,
-            k=jax.lax.dynamic_update_slice(
-                cache["k"], kt[None], (layer_idx, 0, 0, write_index, 0)
-            ),
-            v=jax.lax.dynamic_update_slice(
-                cache["v"], vt[None], (layer_idx, 0, 0, write_index, 0)
-            ),
+            k=_cache_write(cache["k"], kt, layer_idx, write_index),
+            v=_cache_write(cache["v"], vt, layer_idx, write_index),
         )
 
     if stacked_attention_fn is not None:
@@ -516,7 +538,8 @@ def forward(
     tokens: jax.Array,       # [B, S] int32
     positions: jax.Array,    # [B, S] int32 (RoPE positions, pad rows clipped)
     kv_cache: dict,          # {"k","v": [L, B, KV, C, hd]}
-    write_index,             # scalar: cache slot of tokens[:, 0]
+    write_index,             # cache slot of tokens[:, 0]: scalar, or [B]
+    #                          vector for per-row slots (spec verify)
     mask: jax.Array,         # [B, S, C] bool over cache slots
     *,
     remat: bool = False,
@@ -713,3 +736,23 @@ def prefill_positions(pad_lens: jax.Array, seq_len: int) -> jax.Array:
     """RoPE positions for left-padded prompts: max(0, i - pad). [B, S]."""
     i = jnp.arange(seq_len)[None, :]
     return jnp.maximum(0, i - pad_lens[:, None])
+
+
+def verify_attention_mask(
+    pad_lens: jax.Array, fills: jax.Array, num_q: int, cache_len: int
+):
+    """Speculative verify step: ``num_q`` query tokens per row sit at
+    per-row cache slots fills_b .. fills_b + num_q - 1; query i attends
+    j iff pad_b <= j <= fills_b + i. [B, num_q, C]. With num_q=1 and a
+    shared fill this degenerates to decode_attention_mask."""
+    j = jnp.arange(cache_len)[None, None, :]
+    pad = pad_lens[:, None, None]
+    limit = (fills[:, None] + jnp.arange(num_q)[None, :])[:, :, None]
+    return (j >= pad) & (j <= limit)
+
+
+def verify_positions(
+    pad_lens: jax.Array, fills: jax.Array, num_q: int
+) -> jax.Array:
+    """RoPE positions of the verify queries: (fills_b - pad_b) + i. [B, S]."""
+    return (fills - pad_lens)[:, None] + jnp.arange(num_q)[None, :]

@@ -160,6 +160,108 @@ def _kernel(
             o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
+def _verify_kernel(
+    lidx_ref,   # [1] int32 (SMEM) — layer to read
+    fmax_ref,   # [1] int32 (SMEM) — max over rows of (fill + Sq - 1)
+    fmin_ref,   # [1] int32 (SMEM) — min over rows of fill
+    win_ref,    # [1] int32 (SMEM) — sliding window; 0 = global
+    *refs,
+    block_b: int,
+    block_k: int,
+    n_kv: int,
+    n_q: int,
+    scale: float,
+    quantized: bool,
+):
+    """Multi-position decode ("verify") attention for speculative decoding.
+
+    Same block geometry and online-softmax bookkeeping as _kernel, but each
+    row carries Sq query positions at PER-ROW cache offsets: query (b, s)
+    attends slots pad_b <= j <= fill_b + s. The per-(row, query) visibility
+    limit arrives as a pre-broadcast VMEM operand (limits_ref) because the
+    merged (bb*KV, Sq*G) row layout cannot be assembled from SMEM scalars
+    in-kernel; the SCALAR fill bounds (fmax/fmin) only steer DMA elision."""
+    if quantized:
+        (q_ref, pads_ref, lim_ref, k_ref, v_ref, ks_ref, vs_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        (q_ref, pads_ref, lim_ref, k_ref, v_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+        ks_ref = vs_ref = None
+    # q_ref/o_ref [1, BB*KV, Sq*G, hd] (row index s*G + g: query position s,
+    # group head g); pads_ref [1, BB*KV, 1, BK]; lim_ref [1, BB*KV, SqG,
+    # LANES] (per-(row, query) last visible slot, lane-broadcast);
+    # k_ref/v_ref [1, BB, KV, BK, hd]; scratch acc [BB*KV, SqG, hd],
+    # m/l [BB*KV, SqG, LANES]
+
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    fill_hi = fmax_ref[0]
+    fill_lo = fmin_ref[0]
+    win = win_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # blocks wholly past EVERY row's last visible slot — or, with a window,
+    # wholly below every row's window floor — were never DMA'd (clamped
+    # index_map); skip their compute so the duplicate block isn't counted
+    @pl.when(
+        (j * block_k <= fill_hi)
+        & ((win == 0) | (j * block_k + block_k - 1 >= fill_lo - win + 1))
+    )
+    def _compute():
+        hd = q_ref.shape[3]
+        BKV = block_b * n_kv
+        SG = q_ref.shape[2]
+        qb = q_ref[0].astype(jnp.float32)                       # [BKV, SG, hd]
+        kb = k_ref[0].astype(jnp.float32).reshape(BKV, block_k, hd)
+        vb = v_ref[0].astype(jnp.float32).reshape(BKV, block_k, hd)
+
+        s = jax.lax.dot_general(
+            qb, kb, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [BKV, SG, BK]
+        if quantized:
+            s = s * ks_ref[0].reshape(BKV, 1, block_k)
+
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (BKV, 1, block_k), 2
+        )
+        limit = lim_ref[0, :, :, :1]                     # [BKV, SG, 1]
+        mask = (k_pos >= pads_ref[0]) & (k_pos <= limit)
+        # window in slot space per query: k_slot > (fill_b + s) - win
+        mask = mask & ((win == 0) | (k_pos > limit - win))
+        s = jnp.where(mask, s, _NEG)
+
+        m_prev = m_ref[:, :, :1]                         # [BKV, SG, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+
+        l_ref[...] = jnp.broadcast_to(
+            l_ref[:, :, :1] * corr + jnp.sum(p, axis=2, keepdims=True),
+            l_ref.shape,
+        )
+        if quantized:
+            p = p * vs_ref[0].reshape(BKV, 1, block_k)
+        pv = jax.lax.dot_general(
+            p, vb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [BKV, SG, hd]
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
 def _pick_block_b(batch: int) -> int:
     for b in (8, 4, 2, 1):
         if batch % b == 0:
@@ -305,3 +407,130 @@ def flash_decode_attention(
             l[..., 0].reshape(B, H),
         )
     return out.reshape(B, 1, H, hd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("q_per_kv", "block_k", "interpret"),
+)
+def flash_spec_verify_attention(
+    q: jax.Array,          # [B, Sq, H, hd] — Sq = spec_k + 1 verify queries
+    cache: dict,           # stacked {"k","v"[, "ks","vs"]} (llama.init_kv_cache)
+    layer_idx: jax.Array,  # scalar int32
+    pad_lens: jax.Array,   # [B] int32
+    fills: jax.Array,      # [B] int32 — per-row cache slot of query 0
+    q_per_kv: int,
+    window: jax.Array | None = None,  # scalar int32; 0/None = global
+    *,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-position decode attention for the speculative verify step:
+    query (b, s) sits at cache slot fills_b + s and attends
+    pad_b <= j <= fills_b + s (models.llama.verify_attention_mask
+    semantics). Returns [B, Sq, H, hd].
+
+    This is the decode kernel generalized along two axes at once: several
+    query positions per row (the Sq*G rows of one grid cell share each K/V
+    block, so a verify step streams the cache ONCE for all k+1 positions —
+    the whole point of batched verification) and PER-ROW fill offsets
+    (after ragged draft acceptance, rows sit at different cache lengths).
+    DMA elision clamps against the batch-max fill; masking uses the exact
+    per-(row, query) limit."""
+    k_all, v_all = cache["k"], cache["v"]
+    quantized = "ks" in cache
+    B, Sq, H, hd = q.shape
+    L, _, KV, C, _ = k_all.shape
+    if hd % _LANES and not interpret:
+        raise ValueError(f"unsupported verify head_dim={hd}")
+    G = q_per_kv
+    if H != KV * G:
+        raise ValueError(f"q_per_kv={q_per_kv} inconsistent with H/KV={H // KV}")
+    bk = min(block_k, C)
+    bb = _pick_block_b(B)
+    SG = Sq * G
+
+    # merged layout [B//bb, bb*KV, Sq*G, hd] with query position MAJOR over
+    # the group heads (row s*G + g) so one limits row covers a position's
+    # whole GQA group
+    qg = (
+        q.transpose(0, 2, 1, 3)               # [B, H, Sq, hd]
+        .reshape(B, KV, G, Sq, hd)
+        .transpose(0, 1, 3, 2, 4)             # [B, KV, Sq, G, hd]
+        .reshape(B // bb, bb * KV, SG, hd)
+    )
+    pads = jnp.broadcast_to(
+        pad_lens.astype(jnp.int32).reshape(B // bb, bb, 1, 1, 1),
+        (B // bb, bb, KV, 1, bk),
+    ).reshape(B // bb, bb * KV, 1, bk)
+    # per-(row, query) last visible slot, lane-broadcast (the kernel cannot
+    # assemble the merged-row vector from SMEM scalars)
+    limits = fills.astype(jnp.int32)[:, None] + jnp.arange(Sq, dtype=jnp.int32)
+    limits = jnp.broadcast_to(
+        limits[:, None, :, None, None], (B, KV, Sq, G, _LANES)
+    ).reshape(B // bb, bb * KV, SG, _LANES)
+    fill_hi = jnp.max(fills) + Sq - 1
+    fill_lo = jnp.min(fills)
+    grid = (B // bb, pl.cdiv(C, bk))
+
+    def visible_j(j, fmax, fmin, win, blk=bk):
+        lo = jnp.where(
+            win[0] > 0, jnp.maximum(fmin[0] - win[0] + 1, 0) // blk, 0
+        )
+        return jnp.clip(j, lo, fmax[0] // blk)
+
+    def kv_index(b, j, lidx, fmax, fmin, win):
+        return (lidx[0], b, 0, visible_j(j, fmax, fmin, win), 0)
+
+    def scale_index(b, j, lidx, fmax, fmin, win):
+        return (lidx[0], b, 0, visible_j(j, fmax, fmin, win))
+
+    row_block = lambda shape: pl.BlockSpec(  # noqa: E731
+        (1, *shape), lambda b, j, lidx, fmax, fmin, win: (b,) + (0,) * len(shape)
+    )
+    in_specs = [
+        row_block((bb * KV, SG, hd)),
+        row_block((bb * KV, 1, bk)),
+        row_block((bb * KV, SG, _LANES)),
+        pl.BlockSpec((1, bb, KV, bk, hd), kv_index),
+        pl.BlockSpec((1, bb, KV, bk, hd), kv_index),
+    ]
+    operands = [qg, pads, limits, k_all, v_all]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bb, KV, bk), scale_index),
+            pl.BlockSpec((1, bb, KV, bk), scale_index),
+        ]
+        operands += [cache["ks"], cache["vs"]]
+
+    kernel = functools.partial(
+        _verify_kernel, block_b=bb, block_k=bk, n_kv=KV, n_q=Sq,
+        scale=1.0 / (hd ** 0.5), quantized=quantized,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=row_block((bb * KV, SG, hd)),
+            scratch_shapes=[
+                pltpu.VMEM((bb * KV, SG, hd), jnp.float32),
+                pltpu.VMEM((bb * KV, SG, _LANES), jnp.float32),
+                pltpu.VMEM((bb * KV, SG, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B // bb, bb * KV, SG, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer_idx, jnp.int32).reshape(1),
+        jnp.asarray(fill_hi, jnp.int32).reshape(1),
+        jnp.asarray(fill_lo, jnp.int32).reshape(1),
+        jnp.asarray(0 if window is None else window, jnp.int32).reshape(1),
+        *operands,
+    )
+    return (
+        out.reshape(B, KV, Sq, G, hd)
+        .transpose(0, 2, 1, 3, 4)             # [B, Sq, KV, G, hd]
+        .reshape(B, Sq, H, hd)
+    )

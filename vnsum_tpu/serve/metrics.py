@@ -55,6 +55,8 @@ class ServeMetrics:
             self._stats.queue_wait_seconds += rec.queue_wait_s
             self._stats.prompt_tokens += rec.prompt_tokens
             self._stats.generated_tokens += rec.generated_tokens
+            self._stats.draft_tokens += rec.draft_tokens
+            self._stats.accepted_tokens += rec.accepted_tokens
             for i, ub in enumerate(_WAIT_BUCKETS):
                 if rec.queue_wait_s <= ub:
                     self._wait_buckets[i] += 1
@@ -112,6 +114,12 @@ class ServeMetrics:
         counter("generated_tokens_total", s.generated_tokens, "tokens generated")
         gauge("tokens_per_second", round(s.tokens_per_second, 3),
               "cumulative (prompt+generated) tokens / engine second")
+        counter("spec_draft_tokens_total", s.draft_tokens,
+                "tokens proposed by the speculative drafter")
+        counter("spec_accepted_tokens_total", s.accepted_tokens,
+                "drafted tokens the model accepted at verification")
+        gauge("spec_acceptance_rate", round(s.acceptance_rate, 6),
+              "cumulative accepted / drafted tokens (0 when spec is off)")
         if queue_depth is not None:
             gauge("queue_depth", queue_depth, "requests currently queued")
         if queued_tokens is not None:

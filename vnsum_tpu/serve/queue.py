@@ -58,6 +58,10 @@ class ServeRequest:
     prompt: str
     max_new_tokens: int | None = None
     config: GenerationConfig | None = None
+    # source text for reference-guided speculative decoding (vnsum_tpu.spec);
+    # per-ROW metadata, so it never enters batch_key — requests with
+    # different references still coalesce
+    reference: str | None = None
     # absolute time.monotonic() deadline; None = no SLO
     deadline: float | None = None
     est_tokens: int = 0

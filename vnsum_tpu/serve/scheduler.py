@@ -92,17 +92,20 @@ class MicroBatchScheduler:
         config: GenerationConfig | None = None,
         deadline: float | None = None,
         internal: bool = False,
+        reference: str | None = None,
     ):
         """Admit one prompt; returns a Future resolving to a _Completion.
         Raises RequestShed synchronously when admission control rejects.
         ``internal=True`` marks fan-out of already-admitted work (strategy
         rounds riding a QueuedBackend): depth/token admission is skipped —
         the request-level gate is check_admission — while deadline and
-        shutdown shedding still apply."""
+        shutdown shedding still apply. ``reference`` rides the request as
+        per-row speculation metadata (never part of the batch key)."""
         req = ServeRequest(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             config=config,
+            reference=reference,
             deadline=deadline,
             est_tokens=self.backend.count_tokens(prompt),
         )
@@ -119,12 +122,19 @@ class MicroBatchScheduler:
             self.metrics.observe_shed(e.reason)
             raise
 
-    def submit_many(self, prompts, **kw):
+    def submit_many(self, prompts, references=None, **kw):
         """Admit a round of prompts atomically-ish: if any prompt is shed at
         admission, already-admitted siblings are left to complete (they
         occupy queue slots either way) and the shed propagates to the
-        caller — a strategy round is all-or-nothing for its caller."""
-        return [self.submit(p, **kw) for p in prompts]
+        caller — a strategy round is all-or-nothing for its caller.
+        ``references`` optionally aligns one speculation reference per
+        prompt."""
+        if references is None:
+            references = [None] * len(prompts)
+        return [
+            self.submit(p, reference=r, **kw)
+            for p, r in zip(prompts, references)
+        ]
 
     def generate_sync(
         self,
@@ -134,10 +144,11 @@ class MicroBatchScheduler:
         config: GenerationConfig | None = None,
         deadline: float | None = None,
         internal: bool = False,
+        references: list[str | None] | None = None,
     ) -> list[_Completion]:
         futs = self.submit_many(
-            prompts, max_new_tokens=max_new_tokens, config=config,
-            deadline=deadline, internal=internal,
+            prompts, references=references, max_new_tokens=max_new_tokens,
+            config=config, deadline=deadline, internal=internal,
         )
         return [f.result() for f in futs]
 
@@ -181,6 +192,7 @@ class MicroBatchScheduler:
                 [r.prompt for r in batch],
                 max_new_tokens=head.max_new_tokens,
                 config=head.config,
+                references=[r.reference for r in batch],
             )
         except Exception as e:
             engine_s = time.monotonic() - t0
@@ -208,8 +220,19 @@ class MicroBatchScheduler:
                     r.future.set_exception(e)
             return
         gen_tokens = self.backend.count_tokens_batch(outs)
-        for r, out, n_out in zip(batch, outs, gen_tokens):
+        # per-request speculative-decoding attribution: backends with the
+        # spec path expose take_spec_report() — per-prompt records aligned
+        # with the batch, cleared on read. Engine access is single-threaded
+        # (this scheduler thread), so read-after-generate cannot race.
+        take_spec = getattr(self.backend, "take_spec_report", None)
+        spec_report = take_spec() if callable(take_spec) else []
+        if len(spec_report) != len(batch):
+            spec_report = [None] * len(batch)
+        for r, out, n_out, spec in zip(batch, outs, gen_tokens, spec_report):
             rec = self._record(r, "ok", t0, engine_s, len(batch), n_out)
+            if spec is not None:
+                rec.draft_tokens = spec.draft_tokens
+                rec.accepted_tokens = spec.accepted_tokens
             self.metrics.observe_request(rec)
             if not r.future.done():
                 r.future.set_result(_Completion(out, rec))
@@ -273,6 +296,7 @@ class QueuedBackend:
         *,
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
+        references: list[str | None] | None = None,
     ) -> list[str]:
         if not prompts:
             return []
@@ -281,7 +305,7 @@ class QueuedBackend:
         # wide strategy round must not shed itself against the depth budget
         completions = self.scheduler.generate_sync(
             prompts, max_new_tokens=max_new_tokens, config=config,
-            deadline=self.deadline, internal=True,
+            deadline=self.deadline, internal=True, references=references,
         )
         with self._lock:
             self.records.extend(c.record for c in completions)

@@ -59,8 +59,12 @@ class ServeState:
         max_queue_depth: int = 256,
         max_queued_tokens: int = 0,
         default_deadline_s: float | None = None,
+        default_spec_k: int = 0,
     ) -> None:
         self.backend = backend
+        # mirrors the backend's GenerationConfig(spec_k=...) default so a
+        # request-built config (which REPLACES the backend default) keeps it
+        self.default_spec_k = default_spec_k
         self.scheduler = MicroBatchScheduler(
             backend,
             max_batch=max_batch,
@@ -129,19 +133,28 @@ def _deadline_from(req: dict, default_s: float | None) -> float | None:
     return None
 
 
-def _gen_config_from(req: dict) -> GenerationConfig | None:
+def _gen_config_from(
+    req: dict, default_spec_k: int = 0
+) -> GenerationConfig | None:
     knobs = {}
     for key, cast, integer in (
         ("temperature", float, False),
         ("top_k", int, True),
         ("top_p", float, False),
         ("seed", int, True),
+        # per-request speculative-decoding override; the server-level
+        # default comes from --spec-k
+        ("spec_k", int, True),
     ):
         val = _number(req, key, cast, integer=integer)
         if val is not None:
             knobs[key] = val
     if not knobs:
-        return None
+        return None  # backend's own GenerationConfig default applies
+    # a request that customizes only sampling knobs must not silently turn
+    # the server's --spec-k default off: a fresh GenerationConfig would
+    # carry spec_k=0 and fully REPLACE the backend default
+    knobs.setdefault("spec_k", default_spec_k)
     return GenerationConfig(**knobs)
 
 
@@ -240,9 +253,24 @@ def make_handler(state: ServeState):
             if not prompts or not all(isinstance(p, str) and p for p in prompts):
                 self._json({"error": "need 'prompt' or non-empty 'prompts'"}, 400)
                 return
+            # speculation references: "reference" (single) or "references"
+            # (aligned with prompts; null entries allowed)
+            references = req.get("references")
+            if references is None:
+                ref = req.get("reference")
+                references = [ref] * len(prompts) if isinstance(ref, str) else None
+            if references is not None and (
+                not isinstance(references, list)
+                or len(references) != len(prompts)
+                or not all(r is None or isinstance(r, str) for r in references)
+            ):
+                self._json(
+                    {"error": "'references' must align with prompts"}, 400
+                )
+                return
             try:
                 max_new_tokens = _number(req, "max_new_tokens", int, integer=True)
-                config = _gen_config_from(req)
+                config = _gen_config_from(req, state.default_spec_k)
                 deadline = _deadline_from(req, state.default_deadline_s)
             except _BadRequest as e:
                 self._json({"error": str(e)}, 400)
@@ -253,6 +281,7 @@ def make_handler(state: ServeState):
                     max_new_tokens=max_new_tokens,
                     config=config,
                     deadline=deadline,
+                    references=references,
                 )
             except RequestShed as e:
                 self._json({"error": "shed", "reason": e.reason.value}, 429)
@@ -327,6 +356,8 @@ def make_handler(state: ServeState):
                         "queue_wait_s": round(sum(r.queue_wait_s for r in recs), 6),
                         "engine_s": round(sum(r.engine_s for r in recs), 6),
                         "generated_tokens": sum(r.generated_tokens for r in recs),
+                        "draft_tokens": sum(r.draft_tokens for r in recs),
+                        "accepted_tokens": sum(r.accepted_tokens for r in recs),
                         "total_s": round(time.monotonic() - t0, 6),
                     },
                 }
@@ -370,6 +401,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="admission control: max queued prompt tokens (0=off)")
     p.add_argument("--default-deadline-ms", type=float, default=None,
                    help="deadline applied to requests that carry none")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="reference-guided speculative decoding: draft up to "
+                        "K tokens/step from each request's reference text "
+                        "(0 = off; greedy outputs are identical either way)")
     args = p.parse_args(argv)
 
     if args.backend == "tpu":
@@ -378,13 +413,14 @@ def main(argv: list[str] | None = None) -> int:
         backend = get_backend(
             "tpu", model_config=MODEL_REGISTRY[args.model](),
             batch_size=args.max_batch,
+            generation=GenerationConfig(spec_k=args.spec_k),
         )
     elif args.backend == "ollama":
         backend = get_backend("ollama", model=args.model)
     elif args.backend == "hf":
         backend = get_backend("hf", model_name_or_path=args.model)
     else:
-        backend = get_backend("fake")
+        backend = get_backend("fake", spec_k=args.spec_k)
 
     state = ServeState(
         backend,
@@ -396,6 +432,7 @@ def main(argv: list[str] | None = None) -> int:
             args.default_deadline_ms / 1000.0
             if args.default_deadline_ms else None
         ),
+        default_spec_k=args.spec_k,
     )
     server = make_server(state, args.host, args.port)
     logger.info(

@@ -1,0 +1,180 @@
+"""Draft-model-free n-gram reference drafter.
+
+Summarization output overlaps its source document far more than free-form
+generation does — map/collapse/refine calls largely re-emit spans of the
+text they were handed. That is the regime where reference-guided speculation
+("Inference with Reference", arXiv:2304.04487) is lossless and cheap: instead
+of a draft model, the drafter suffix-matches the tokens already emitted
+against the request's source-document tokens and proposes the continuation
+that follows the longest match. Verification (backend/engine.py spec path)
+feeds the k proposed tokens through ONE batched forward and accepts the
+longest prefix the model itself would have produced, so greedy outputs are
+bit-identical to plain decode by construction — speculation only changes how
+many tokens each dispatch retires.
+
+Two implementations of the same contract:
+
+- :func:`propose_drafts` — pure jnp on fixed shapes, so it runs inside the
+  engine's jitted spec step (no host sync on the decode path);
+- :func:`propose_drafts_host` — plain numpy mirror for host-side callers
+  (FakeBackend-style doubles, debugging, and the equivalence tests that pin
+  the jnp version's semantics).
+
+Both return, per batch row, up to ``k`` draft tokens and the count actually
+proposed. Rows with no reference, no match, or an exhausted reference
+propose zero drafts — the verify step then degrades to one token per step,
+exactly plain decode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# sentinel for "no token here" in history tails / reference padding; never a
+# valid token id, so it can never produce a spurious match
+NO_TOKEN = -1
+
+
+def encode_references(
+    tok,
+    references: list[str | None],
+    max_ref_tokens: int = 4096,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side packing of per-request reference texts into fixed-shape
+    buffers: (ref_tokens [B, R] int32 padded with NO_TOKEN, ref_lens [B]).
+
+    ``R`` is the longest encoded reference clamped to ``max_ref_tokens``
+    (references are matched, not attended — truncating one only costs draft
+    coverage of its tail, never correctness). ``None`` entries get length 0:
+    those rows never draft."""
+    encoded: list[list[int]] = []
+    for r in references:
+        if not r:
+            encoded.append([])
+            continue
+        ids = tok.encode(r, add_bos=False)
+        encoded.append(ids[:max_ref_tokens])
+    R = max((len(e) for e in encoded), default=0)
+    R = max(R, 1)  # zero-width buffers make degenerate jit shapes
+    out = np.full((len(encoded), R), NO_TOKEN, dtype=np.int32)
+    lens = np.zeros((len(encoded),), dtype=np.int32)
+    for i, ids in enumerate(encoded):
+        out[i, : len(ids)] = ids
+        lens[i] = len(ids)
+    return out, lens
+
+
+def propose_drafts(ref, ref_lens, tail, k: int):
+    """Batched n-gram suffix-match drafting, pure jnp (jit-safe).
+
+    ref       [B, R] int32 — reference tokens, NO_TOKEN-padded
+    ref_lens  [B]    int32 — valid prefix length of each row's reference
+    tail      [B, N] int32 — the last N tokens of each row's emitted stream
+                             (tail[:, -1] is the most recent, i.e. the token
+                             about to be fed to the model), NO_TOKEN where
+                             the stream is shorter than N
+    k         static int   — max draft tokens to propose
+
+    Returns (drafts [B, k] int32, n_draft [B] int32). drafts[:, i] for
+    i >= n_draft are 0-filled (valid-but-ignored ids: the verify step masks
+    them out of acceptance, they only pad the fixed-shape forward).
+
+    Match rule: for every reference position p, the match length m(p) is the
+    number of trailing emitted tokens that equal ref[p - i] walking
+    backwards (capped at N). The winner maximizes (m, p) — longest suffix
+    match first, latest occurrence to break ties (later spans tend to carry
+    the continuation the model is currently producing). Rows whose best
+    m == 0 or whose winning position has no continuation left propose
+    nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    B, R = ref.shape
+    N = tail.shape[1]
+    tail_rev = tail[:, ::-1]  # tail_rev[:, i] = i-th most recent token
+
+    # idx[p, i] = p - i: reference position holding the i-th most recent
+    # token if the match ends at p
+    p_idx = jnp.arange(R)[:, None] - jnp.arange(N)[None, :]  # [R, N]
+    valid = p_idx >= 0
+    gathered = jnp.take(ref, jnp.clip(p_idx, 0, R - 1), axis=1)  # [B, R, N]
+    eq = (
+        (gathered == tail_rev[:, None, :])
+        & valid[None]
+        & (tail_rev[:, None, :] != NO_TOKEN)
+        & (gathered != NO_TOKEN)
+    )
+    # consecutive-match length along the suffix axis
+    m = jnp.cumprod(eq.astype(jnp.int32), axis=2).sum(axis=2)  # [B, R]
+    # a position only counts inside the row's real reference AND with at
+    # least one continuation token left — a match ending the reference
+    # proposes nothing, so it must not shadow a drafting-capable match
+    pos = jnp.arange(R)[None, :]
+    usable = (pos + 1) < ref_lens[:, None]
+    m = jnp.where(usable, m, 0)
+    score = m * (R + 1) + pos
+    best = jnp.argmax(score, axis=1).astype(jnp.int32)        # [B]
+    best_m = jnp.take_along_axis(m, best[:, None], axis=1)[:, 0]
+
+    # continuation after the match, clamped at the reference end
+    start = best + 1
+    avail = jnp.maximum(ref_lens - start, 0)
+    n_draft = jnp.where(best_m > 0, jnp.minimum(avail, k), 0)
+
+    ref_pad = jnp.concatenate(
+        [ref, jnp.zeros((B, k), dtype=ref.dtype)], axis=1
+    )
+    drafts = jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice(row, (s,), (k,))
+    )(ref_pad, jnp.minimum(start, R))
+    # zero the unproposed tail so NO_TOKEN padding never reaches the forward
+    drafts = jnp.where(
+        jnp.arange(k)[None, :] < n_draft[:, None], drafts, 0
+    ).astype(jnp.int32)
+    return drafts, n_draft.astype(jnp.int32)
+
+
+def propose_drafts_host(
+    ref: np.ndarray, ref_lens: np.ndarray, tail: np.ndarray, k: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Numpy mirror of :func:`propose_drafts` — identical semantics, host
+    execution. The straightforward per-row loop doubles as executable
+    documentation of the match rule; tests assert the two agree."""
+    B, R = ref.shape
+    N = tail.shape[1]
+    drafts = np.zeros((B, k), dtype=np.int32)
+    n_draft = np.zeros((B,), dtype=np.int32)
+    for b in range(B):
+        L = int(ref_lens[b])
+        best_m, best_p = 0, -1
+        for p in range(L - 1):  # p = L-1 has no continuation: never usable
+            m = 0
+            for i in range(N):
+                if p - i < 0:
+                    break
+                t = int(tail[b, N - 1 - i])
+                if t == NO_TOKEN or int(ref[b, p - i]) != t:
+                    break
+                m += 1
+            if m >= best_m and m > 0:  # ties break toward the later p
+                best_m, best_p = m, p
+        if best_m == 0:
+            continue
+        n = min(k, L - (best_p + 1))
+        drafts[b, :n] = ref[b, best_p + 1 : best_p + 1 + n]
+        n_draft[b] = n
+    return drafts, n_draft
+
+
+def history_tail(out: np.ndarray, out_lens: np.ndarray, cur: np.ndarray,
+                 n: int) -> np.ndarray:
+    """Host helper: the last ``n`` tokens of each row's emitted stream —
+    out[b, :out_lens[b]] followed by cur[b] — NO_TOKEN-padded on the left.
+    The jitted spec step computes the same thing on-device; this exists for
+    host-side drafting (propose_drafts_host callers)."""
+    B = out.shape[0]
+    tail = np.full((B, n), NO_TOKEN, dtype=np.int32)
+    for b in range(B):
+        hist = list(out[b, : int(out_lens[b])]) + [int(cur[b])]
+        take = hist[-n:]
+        tail[b, n - len(take):] = take
+    return tail

@@ -59,14 +59,34 @@ class _BatchCounter:
         self.max_new_tokens = max_new_tokens
         self.calls_by_owner: dict[int, int] = {}
 
-    def __call__(self, prompts: list[str], owners: list[int]) -> list[str]:
+    def __call__(
+        self,
+        prompts: list[str],
+        owners: list[int],
+        references: list[str | None] | None = None,
+    ) -> list[str]:
+        """``references`` optionally aligns one source text per prompt —
+        the seam reference-guided speculative decoding rides (strategies
+        pass the chunk being summarized; backends without speculation
+        ignore it)."""
         if not prompts:
             return []
         if len(owners) != len(prompts):
             raise ValueError("owners must tag every prompt")
+        if references is not None and len(references) != len(prompts):
+            raise ValueError("references must align with prompts")
         for o in owners:
             self.calls_by_owner[o] = self.calls_by_owner.get(o, 0) + 1
-        return self.backend.generate(prompts, max_new_tokens=self.max_new_tokens)
+        if references is None or not any(references):
+            # keep the legacy call shape for backends (and test doubles)
+            # that predate the references kwarg
+            return self.backend.generate(
+                prompts, max_new_tokens=self.max_new_tokens
+            )
+        return self.backend.generate(
+            prompts, max_new_tokens=self.max_new_tokens,
+            references=references,
+        )
 
 
 def split_by_token_budget(

@@ -53,6 +53,9 @@ class IterativeStrategy:
                     ITERATIVE_INITIAL.format(context=chunks_per_doc[di][0])
                     for di in idx
                 ]
+                # speculation references (vnsum_tpu.spec): the seed summary
+                # extracts from its chunk
+                refs = [chunks_per_doc[di][0] for di in idx]
             else:
                 prompts = [
                     ITERATIVE_REFINE.format(
@@ -61,7 +64,13 @@ class IterativeStrategy:
                     )
                     for di in idx
                 ]
-            outs = gen(prompts, owners=idx)
+                # a refine rewrite mostly re-emits the existing summary with
+                # spans of the new chunk folded in — both are draftable
+                refs = [
+                    summaries[di] + "\n\n" + chunks_per_doc[di][r]
+                    for di in idx
+                ]
+            outs = gen(prompts, owners=idx, references=refs)
             for di, out in zip(idx, outs):
                 summaries[di] = out
 

@@ -68,15 +68,22 @@ class MapReduceStrategy:
             StrategyResult(summary="", num_chunks=len(c)) for c in chunks_per_doc
         ]
 
-        # map: every chunk of every document in one batch
+        # map: every chunk of every document in one batch. The chunk text
+        # rides along as the speculation reference — a map summary is
+        # largely extractive, exactly the overlap the reference drafter
+        # (vnsum_tpu.spec) turns into accepted tokens
         flat = [
-            (di, self.map_prompt.format(content=c))
+            (di, self.map_prompt.format(content=c), c)
             for di, chunks in enumerate(chunks_per_doc)
             for c in chunks
         ]
-        outs = gen([p for _, p in flat], owners=[di for di, _ in flat])
+        outs = gen(
+            [p for _, p, _ in flat],
+            owners=[di for di, _, _ in flat],
+            references=[c for _, _, c in flat],
+        )
         summaries: list[list[str]] = [[] for _ in docs]
-        for (di, _), out in zip(flat, outs):
+        for (di, _, _), out in zip(flat, outs):
             summaries[di].append(out)
 
         # collapse + final rounds, MERGED: a document whose summaries already
@@ -108,9 +115,12 @@ class MapReduceStrategy:
                 over = []
             batch: list[tuple[str, int, int]] = []
             prompts: list[str] = []
+            refs: list[str] = []
             for di in ready:
                 batch.append(("final", di, 0))
                 prompts.append(self._reduce_one(summaries[di]))
+                # reduce output re-emits spans of the summaries it merges
+                refs.append("\n\n".join(summaries[di]))
             grouped: dict[int, list[list[str]]] = {}
             for di in over:
                 groups = split_by_token_budget(summaries[di], self.token_max, self.count)
@@ -118,9 +128,12 @@ class MapReduceStrategy:
                 for gi, g in enumerate(groups):
                     batch.append(("collapse", di, gi))
                     prompts.append(self._reduce_one(g))
+                    refs.append("\n\n".join(g))
             if not prompts:
                 break
-            outs = gen(prompts, owners=[di for _, di, _ in batch])
+            outs = gen(
+                prompts, owners=[di for _, di, _ in batch], references=refs
+            )
             for di in over:
                 summaries[di] = [None] * len(grouped[di])  # type: ignore[list-item]
             for (kind, di, gi), out in zip(batch, outs):

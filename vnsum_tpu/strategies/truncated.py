@@ -45,8 +45,10 @@ class TruncatedStrategy:
         self, docs: list[str], *, backend: Backend | None = None
     ) -> list[StrategyResult]:
         gen = _BatchCounter(backend or self.backend, self.max_new_tokens)
-        prompts = [TRUNCATED.format(text=self._truncate(d)) for d in docs]
-        outs = gen(prompts, owners=list(range(len(docs))))
+        truncated = [self._truncate(d) for d in docs]
+        prompts = [TRUNCATED.format(text=t) for t in truncated]
+        # the truncated document is the speculation reference (vnsum_tpu.spec)
+        outs = gen(prompts, owners=list(range(len(docs))), references=truncated)
         return [
             StrategyResult(summary=o, num_chunks=1, llm_calls=1, rounds=1)
             for o in outs
