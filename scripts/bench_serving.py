@@ -327,13 +327,17 @@ def main(argv=None) -> int:
     serial_closed["engine_batches"] = len(serial_backend.batch_sizes)
     serial_closed["avg_batch_occupancy"] = 1.0
 
-    # 2) micro-batching serve server — same latency model
+    # 2) micro-batching serve server — same latency model. Tracing is OFF
+    # (trace_sample=0): the goodput comparison is the acceptance criterion
+    # for the obs layer's disabled-path overhead (< 2% vs the PR 1 shape);
+    # the /metrics histograms are always on and snapshotted below anyway.
     serve_backend = FakeBackend(**lat)
     state = ServeState(
         serve_backend,
         max_batch=args.max_batch,
         max_wait_s=args.max_wait_ms / 1000.0,
         max_queue_depth=64,
+        trace_sample=0.0,
     )
     server = make_server(state, "127.0.0.1", 0)
     vt = threading.Thread(target=server.serve_forever, daemon=True)
@@ -349,7 +353,42 @@ def main(argv=None) -> int:
         round(sum(serve_backend.batch_sizes) / nb, 2) if nb else 0.0
     )
 
-    # 3) overload: bounded queue + tight deadline -> typed sheds
+    # 3) tracing-overhead arm: SAME latency model and load with full request
+    # tracing on (trace_sample=1.0) — the goodput delta vs the untraced arm
+    # IS the obs layer's cost, and this arm's histograms carry real anchored
+    # TTFT quantiles (the untraced arm has no prefill anchor, so its TTFT
+    # histogram is empty by design rather than e2e relabeled)
+    traced_backend = FakeBackend(**lat)
+    traced_state = ServeState(
+        traced_backend,
+        max_batch=args.max_batch,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        max_queue_depth=64,
+        trace_sample=1.0,
+        trace_ring=64,
+    )
+    traced_server = make_server(traced_state, "127.0.0.1", 0)
+    tt = threading.Thread(target=traced_server.serve_forever, daemon=True)
+    tt.start()
+    traced_base = f"http://127.0.0.1:{traced_server.server_address[1]}"
+    print(f"traced serve server on {traced_base} ...", flush=True)
+    serve_traced = closed_loop(
+        traced_base, args.clients, args.per_client, args.deadline_s
+    )
+    traced_server.shutdown()
+    traced_server.server_close()
+    traced_hists = traced_state.scheduler.metrics.histograms_snapshot()
+    traced_state.close()
+    tracing_overhead_pct = (
+        round(
+            (serve_closed["goodput_rps"] - serve_traced["goodput_rps"])
+            / serve_closed["goodput_rps"] * 100.0,
+            2,
+        )
+        if serve_closed["goodput_rps"] else 0.0
+    )
+
+    # 4) overload: bounded queue + tight deadline -> typed sheds
     print("overload phase ...", flush=True)
     overload = overload_loop(
         serve_base, args.overload_workers, args.overload_s,
@@ -392,18 +431,32 @@ def main(argv=None) -> int:
         "closed_loop": {
             "serial_baseline": serial_closed,
             "serve": serve_closed,
+            "serve_traced": serve_traced,
             "goodput_speedup": round(speedup, 2),
+            # the obs layer's measured cost: untraced vs fully-traced
+            # goodput on the identical load (<2% is the acceptance bar)
+            "tracing_overhead_pct": tracing_overhead_pct,
         },
         "overload": {
             **overload,
             "shed_counters": shed_lines,
         },
         "serving_stats": stats.to_dict(),
+        # server-side histogram snapshots (vnsum_tpu.obs): bucket counts
+        # plus bucket-derived p50/p95/p99 for queue wait, TTFT, e2e latency,
+        # batch occupancy — quantiles from the same state /metrics scrapes,
+        # not just the client-observed means above. The untraced arm's TTFT
+        # histogram is empty by design (no prefill anchor); the traced arm
+        # carries the real TTFT distribution
+        "histograms": state.scheduler.metrics.histograms_snapshot(),
+        "histograms_traced": traced_hists,
     }
     Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
     print(json.dumps(out["closed_loop"], indent=2))
     print(f"goodput speedup: {speedup:.2f}x "
           f"({serve_closed['goodput_rps']} vs {serial_closed['goodput_rps']} rps)")
+    print(f"tracing overhead: {tracing_overhead_pct}% "
+          f"({serve_traced['goodput_rps']} rps fully traced)")
     print(f"sheds under overload: {overload['shed']} "
           f"(metrics: {shed_lines})")
     print(f"wrote {args.out}")

@@ -90,6 +90,15 @@ def run_arm(backend, prompts, refs, spec_k: int, max_new: int):
     emitted = sum(
         len(backend.tok.encode(o, add_bos=False)) for o in outs
     )
+    # per-prompt accepted-per-step distribution through the SAME fixed
+    # buckets /metrics exports (vnsum_serve_spec_accepted_per_step), so the
+    # bench reports bucket-derived p50/p95/p99 instead of a bare mean
+    from vnsum_tpu.obs.histogram import ACCEPT_BUCKETS, Histogram
+
+    hist = Histogram(ACCEPT_BUCKETS)
+    for r in report:
+        if r.verify_steps:
+            hist.observe(r.accepted_tokens / r.verify_steps)
     return {
         "spec_k": spec_k,
         "wall_s": round(wall, 3),
@@ -100,6 +109,7 @@ def run_arm(backend, prompts, refs, spec_k: int, max_new: int):
         "accepted_tokens": accepted,
         "acceptance_rate": round(accepted / drafted, 4) if drafted else 0.0,
         "accepted_per_step": round(accepted / steps, 4) if steps else 0.0,
+        "accepted_per_step_hist": hist.to_dict(),
         "per_prompt": [r.to_dict() for r in report],
     }, outs
 

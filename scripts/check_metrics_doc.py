@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""CI lint: every metric registered in serve/metrics.py must appear in the
+README's observability metrics table.
+
+The registry keeps metric names as literal strings in `_reg("...")` calls
+exactly so this check can PARSE the source instead of importing it — the
+lint runs before dependencies are installed and can never be skewed by
+import-time failures. Fails (exit 1) listing any registered metric whose
+full `vnsum_serve_*` name is missing from README.md.
+
+    python scripts/check_metrics_doc.py
+"""
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+METRICS_PY = ROOT / "vnsum_tpu" / "serve" / "metrics.py"
+README = ROOT / "README.md"
+
+_REG = re.compile(r'_reg\(\s*"([a-z0-9_]+)"', re.MULTILINE)
+
+
+def registered_names() -> list[str]:
+    src = METRICS_PY.read_text(encoding="utf-8")
+    names = _REG.findall(src)
+    if not names:
+        raise SystemExit(
+            f"no _reg(\"...\") registrations found in {METRICS_PY} — "
+            "registry moved? update scripts/check_metrics_doc.py"
+        )
+    return [f"vnsum_serve_{n}" for n in names]
+
+
+def main() -> int:
+    readme = README.read_text(encoding="utf-8")
+    missing = [n for n in registered_names() if n not in readme]
+    if missing:
+        print("metrics registered in serve/metrics.py but missing from the "
+              "README observability table:")
+        for n in missing:
+            print(f"  - {n}")
+        return 1
+    print(f"ok: all {len(registered_names())} registered metrics documented "
+          "in README.md")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -371,10 +371,19 @@ def test_sampling_vocab_warns_on_unsampleable_terminator(caplog):
     from vnsum_tpu.backend import base as backend_base
 
     backend_base._warned_unsampleable.clear()
-    with caplog.at_level(logging.WARNING, logger="vnsum.backend"):
-        limit, allowed = sampling_vocab(ByteTokenizer(), 200, (257,))
-        # per-bucket program rebuilds must not repeat the warning
-        sampling_vocab(ByteTokenizer(), 200, (257,))
+    # the vnsum root stops propagating once core.logging installs its own
+    # handler (no double emission); caplog captures at the GLOBAL root, so
+    # re-enable propagation for the capture window
+    vroot = logging.getLogger("vnsum")
+    old_propagate = vroot.propagate
+    vroot.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING, logger="vnsum.backend"):
+            limit, allowed = sampling_vocab(ByteTokenizer(), 200, (257,))
+            # per-bucket program rebuilds must not repeat the warning
+            sampling_vocab(ByteTokenizer(), 200, (257,))
+    finally:
+        vroot.propagate = old_propagate
     assert caplog.text.count("terminator ids [257]") == 1
     assert limit == 200 and allowed is None  # decodable clamps to the head
 

@@ -103,7 +103,9 @@ def test_generate_expired_deadline_is_429_shed(serve_url):
               {"prompt": "trễ hạn " * 5, "deadline_ms": 0})
     assert exc.value.code == 429
     body = json.loads(exc.value.read())
-    assert body == {"error": "shed", "reason": "deadline"}
+    assert body["error"] == "shed" and body["reason"] == "deadline"
+    # even sheds carry the correlation id (satellite: request-id plumbing)
+    assert body["request_id"]
 
 
 def test_summarize_full_strategy_with_serving_record(serve_url):

@@ -6,6 +6,17 @@ times (SURVEY.md §2 C2). Here there is ONE interface, and it is batched:
 of a round (across chunks and across documents) as one unit. TpuBackend turns
 that into sharded device batches; OllamaBackend loops over HTTP for parity;
 FakeBackend is the deterministic hermetic test double (SURVEY.md §4).
+
+Optional observability contract (vnsum_tpu.obs): backends MAY publish phase
+telemetry from inside generate() via ``obs.trace.emit(name, t0, dur, ...)``
+— host timestamps around already-dispatched device calls, never extra
+device syncs. emit() no-ops on a single contextvar read unless a caller
+(the serving scheduler, a bench) installed a collector, so backends wrap
+their hot paths unconditionally. Recognized phase names: "tokenize",
+"prefill"/"spec_prefill" (their end is the TTFT anchor), "decode",
+"decode_seg", "spec_step", "dispatch" (fused one-shot program),
+"detokenize". TpuBackend and FakeBackend implement it; HTTP parity backends
+(ollama/hf) simply emit nothing.
 """
 from __future__ import annotations
 

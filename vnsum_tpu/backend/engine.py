@@ -13,6 +13,13 @@ becomes length-bucketed, fixed-shape [B, S] device batches:
 - greedy or sampled decoding with per-sequence EOS masking inside the loop;
 - params and token batches carry NamedShardings over a (data, model) mesh, so
   the same program runs single-chip or TP/DP-sharded with GSPMD collectives.
+
+Telemetry: the host loops publish phase events (tokenize, prefill,
+dispatch, decode_seg, spec_step, detokenize) through obs.trace.emit() — host
+timestamps around device calls whose sync the loop already paid (done-mask /
+result fetches), a no-op unless a collector is installed (the serving
+scheduler's BatchTrace; see backend/base.py for the contract). These feed
+the vnsum_serve_ttft_seconds anchor and the /debug/trace batch tracks.
 """
 from __future__ import annotations
 
@@ -36,6 +43,7 @@ from .base import (
     trim_to_eos,
 )
 from ..core.profiling import annotate
+from ..obs.trace import current_collector, emit
 from ..models.llama import (
     LlamaConfig,
     decode_attention_mask,
@@ -761,8 +769,14 @@ class TpuBackend:
         for row, i in enumerate(group):
             rows[row] = i
 
+        # telemetry gate (vnsum_tpu.obs): resolved ONCE per dispatch — the
+        # collector is installed around the whole generate() call, so inside
+        # it the answer cannot change, and per-segment emit bookkeeping
+        # (timestamps, mask reductions, kwargs) is skipped entirely when off
+        tracing = current_collector() is not None
         prefill = self._get_seg_fn("prefill", B, S, max_new, gen)
         t_pre = time.time()
+        t_pre_m = time.monotonic()
         with annotate(f"prefill[B={B},S={S}]"):
             cur, cache, done = prefill(self.params, tokens, pads, seed)
             if self.instrument:
@@ -770,6 +784,12 @@ class TpuBackend:
                 # cheapest output — prefill device time is now bounded
                 np.asarray(done)
         prefill_s = time.time() - t_pre
+        # engine step telemetry (vnsum_tpu.obs): host timestamps around the
+        # dispatched device call — no extra sync; without instrument=True the
+        # dispatch is async and this bounds submission, not device time
+        if tracing:
+            emit("prefill", t_pre_m, prefill_s, B=B, S=S,
+                 occupancy=len(group), synced=self.instrument)
         if self.instrument:
             self.stats.add_phase("prefill", prefill_s)
         self.stats.batches += 1
@@ -789,6 +809,7 @@ class TpuBackend:
         t_h = 0
         while True:
             t_seg = time.time()
+            t_seg_m = time.monotonic() if tracing else 0.0
             segment = self._get_seg_fn("segment", B, S, max_new, gen)
             with annotate(f"decode_seg[B={B},S={S}]"):
                 t, cur, cache, done, out = segment(
@@ -798,7 +819,16 @@ class TpuBackend:
                 )
             done_h = np.asarray(done)  # fetch = sync; segment time is real
             t_h = int(t)
-            decode_s += time.time() - t_seg
+            seg_s = time.time() - t_seg
+            decode_s += seg_s
+            # per-segment telemetry: the done fetch above already synced, so
+            # these are true device-step timings; kv_frac is the cache fill
+            # at segment end — the decode-attention byte budget driver. The
+            # mask reduction + kwargs are gated: untraced runs pay nothing
+            if tracing:
+                emit("decode_seg", t_seg_m, seg_s, B=B, S=S, steps=t_h,
+                     live=int((~done_h).sum()),
+                     kv_frac=round((S + t_h) / (S + max_new), 4))
             live = [r for r, orig in enumerate(rows) if orig is not None]
             active = [r for r in live if not done_h[r]]
             if t_h >= max_new or not active:
@@ -1013,13 +1043,18 @@ class TpuBackend:
         lens_full = np.zeros((B,), dtype=np.int32)
         lens_full[: len(group)] = ref_lens_np
 
+        tracing = current_collector() is not None  # once per dispatch
         prefill = self._get_seg_fn("prefill", B, S, max_new + k + 1, gen)
         t_pre = time.time()
+        t_pre_m = time.monotonic()
         with annotate(f"spec_prefill[B={B},S={S}]"):
             cur, cache, done = prefill(self.params, tokens, pads, seed)
         if self.instrument:
             np.asarray(done)
             self.stats.add_phase("prefill", time.time() - t_pre)
+        if tracing:
+            emit("spec_prefill", t_pre_m, time.time() - t_pre, B=B, S=S,
+                 occupancy=len(group), synced=self.instrument)
         self.stats.batches += 1
         self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
 
@@ -1036,16 +1071,26 @@ class TpuBackend:
         prev_done = np.asarray(done)
         t_dec = time.time()
         while not prev_done.all():
+            t_step = time.monotonic() if tracing else 0.0
             with annotate(f"spec_step[B={B},S={S},k={k}]"):
                 cur, cache, done, e, out, nd, acc = fn(
                     self.params, cur, cache, done, e, out, pad_dev,
                     ref_dev, lens_dev, seed,
                 )
             steps_live += ~prev_done
-            drafted += np.asarray(nd)
-            accepted += np.asarray(acc)
+            nd_h, acc_h = np.asarray(nd), np.asarray(acc)
+            drafted += nd_h
+            accepted += acc_h
             self.stats.spec_verify_steps += 1
             prev_done = np.asarray(done)
+            # per-verify-step telemetry: the nd/acc/done fetches above are
+            # the sync the loop already paid — drafted vs accepted feeds the
+            # rolling acceptance gauge's per-step ground truth. Gated: the
+            # sums/kwargs cost nothing on untraced runs
+            if tracing:
+                emit("spec_step", t_step, time.monotonic() - t_step, B=B,
+                     k=k, live=int((~prev_done).sum()),
+                     drafted=int(nd_h.sum()), accepted=int(acc_h.sum()))
         if self.instrument:
             self.stats.add_phase("spec_decode", time.time() - t_dec)
         self.stats.spec_draft_tokens += int(drafted[: len(group)].sum())
@@ -1140,9 +1185,14 @@ class TpuBackend:
         self.stats.calls += 1
         self.stats.prompts += len(prompts)
 
+        # telemetry gate, resolved once per generate() call (see the obs
+        # contract in backend/base.py): untraced runs skip every emit's
+        # timestamp/kwargs work, not just the emit itself
+        tracing = current_collector() is not None
         max_input = self.cfg.max_seq_len - max_new
         encoded: list[list[int]] = []
         t_enc = time.time()
+        t_enc_m = time.monotonic()
         # ONE batched call into the tokenizer (Rust side parallelizes and
         # skips per-prompt Python overhead; measured 1.4x on this phase)
         for ids in self.tok.encode_batch(prompts, add_bos=True):
@@ -1151,6 +1201,9 @@ class TpuBackend:
             encoded.append(ids)
             self.stats.prompt_tokens += len(ids)
         self.stats.add_phase("tokenize_host", time.time() - t_enc)
+        if tracing:
+            emit("tokenize", t_enc_m, time.time() - t_enc,
+                 prompts=len(prompts))
 
         # group indices by bucketed length, then emit fixed-shape batches
         order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
@@ -1186,12 +1239,24 @@ class TpuBackend:
                 continue
             tokens, pad_lens, B, S = self._pack_group(group, encoded, max_new)
             fn = self._get_fn(B, S, max_new, gen)
+            t_disp = time.monotonic() if tracing else 0.0
             with annotate(f"generate[B={B},S={S}]"):
                 out = np.asarray(fn(self.params, tokens, pad_lens, seed))
+            # the fused prefill+decode program has no observable midpoint:
+            # one "dispatch" event bounds the whole device call (the result
+            # fetch above synced it) — TTFT consumers treat its end as the
+            # first-token upper bound
+            if tracing:
+                emit("dispatch", t_disp, time.monotonic() - t_disp,
+                     B=B, S=S, occupancy=len(group), max_new=max_new)
             self.stats.batches += 1
             self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
+            t_detok = time.monotonic() if tracing else 0.0
             for row, i in enumerate(group):
                 results[i] = self._detok(out[row], tuple(gen.eos_ids))
+            if tracing:
+                emit("detokenize", t_detok, time.monotonic() - t_detok,
+                     rows=len(group))
         self.stats.generate_seconds += time.time() - t0
         if spec_on:
             from ..spec import SpecRecord

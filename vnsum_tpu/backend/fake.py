@@ -29,6 +29,7 @@ import re
 import time
 
 from ..core.config import GenerationConfig
+from ..obs.trace import current_collector, emit
 from ..spec import SpecRecord
 from ..text.tokenizer import whitespace_token_count
 
@@ -88,8 +89,18 @@ class FakeBackend:
         self.references_seen.extend(
             references if references is not None else [None] * len(prompts)
         )
+        t0 = time.monotonic() if current_collector() is not None else 0.0
         if self.batch_overhead_s or self.per_prompt_s:
             time.sleep(self.batch_overhead_s + self.per_prompt_s * len(prompts))
+        # engine-telemetry contract mirror: the latency model's fixed
+        # per-dispatch cost plays the prefill phase and the marginal
+        # per-row cost plays decode, so hermetic serving runs get the same
+        # prefill/decode structure (and TTFT anchor) TpuBackend emits —
+        # emit() is a no-op unless the scheduler installed a BatchTrace
+        if t0:
+            emit("prefill", t0, self.batch_overhead_s, B=len(prompts))
+            emit("decode", t0 + self.batch_overhead_s,
+                 self.per_prompt_s * len(prompts), B=len(prompts))
         outs = [self._one(p) for p in prompts]
         k = config.spec_k if config is not None else self.spec_k
         self._spec_report = [

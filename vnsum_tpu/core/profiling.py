@@ -8,7 +8,12 @@ those capabilities and makes them first-class:
 
 - `Tracer.span(name)` — nested wall-clock spans with aggregated statistics,
   thread-safe (strategy batches may fan out over a thread pool), persisted in
-  the structured run record instead of log lines.
+  the structured run record instead of log lines. Rebased onto the obs span
+  model (`obs/trace.SpanRecorder`): pipeline runs and the serving layer now
+  share ONE span primitive, so a pipeline run can export the same
+  Perfetto-loadable Chrome trace the serving `/debug/trace` endpoint serves
+  (`Tracer.chrome_trace()`, written next to results by pipeline/runner.py
+  when profiling is armed).
 - `device_profile(log_dir)` — `jax.profiler.trace` wrapper producing TensorBoard
   / Perfetto traces of the on-device work (the TPU-native analog of the
   reference's LangSmith tracing). Gated: no-op unless a directory is given or
@@ -23,7 +28,9 @@ import contextlib
 import os
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
+
+from ..obs.trace import Span, SpanRecorder
 
 
 @dataclass
@@ -49,46 +56,52 @@ class SpanStats:
         }
 
 
-@dataclass
 class Tracer:
-    """Aggregating wall-clock tracer.
+    """Aggregating wall-clock tracer over the shared obs span model.
 
     Span names are hierarchical: nested spans get `parent/child` keys, so the
     run record shows e.g. `summarize/batch` under `summarize`. One Tracer is
     shared per pipeline run; use `reset()` between runs.
+
+    Two views of the same spans: `stats()` aggregates per name (bounded
+    state, any run length — what lands in the run record), and `timeline()`
+    keeps the first `timeline_maxlen` raw spans for `chrome_trace()` export.
+    The recorder's `on_close` hook feeds aggregation, so the two views can
+    never disagree about a span's duration.
     """
 
-    _stats: dict[str, SpanStats] = field(default_factory=dict)
-    _lock: threading.Lock = field(default_factory=threading.Lock)
-    _local: threading.local = field(default_factory=threading.local)
+    def __init__(self, timeline_maxlen: int = 4096) -> None:
+        self._stats: dict[str, SpanStats] = {}
+        self._lock = threading.Lock()
+        self._rec = SpanRecorder(maxlen=timeline_maxlen,
+                                 on_close=self._aggregate)
 
-    def _stack(self) -> list[str]:
-        if not hasattr(self._local, "stack"):
-            self._local.stack = []
-        return self._local.stack
+    def _aggregate(self, full_name: str, duration: float) -> None:
+        with self._lock:
+            self._stats.setdefault(full_name, SpanStats()).add(duration)
 
-    @contextlib.contextmanager
     def span(self, name: str):
-        stack = self._stack()
-        full = "/".join([*stack, name])
-        stack.append(name)
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            duration = time.perf_counter() - t0
-            stack.pop()
-            with self._lock:
-                self._stats.setdefault(full, SpanStats()).add(duration)
+        return self._rec.span(name)
 
     def record(self, name: str, duration: float) -> None:
         """Record an externally-timed span (e.g. a device-side step time)."""
-        with self._lock:
-            self._stats.setdefault(name, SpanStats()).add(duration)
+        self._aggregate(name, duration)
+        self._rec.add(name, time.monotonic() - duration, duration)
 
     def stats(self) -> dict[str, dict]:
         with self._lock:
             return {k: v.to_dict() for k, v in sorted(self._stats.items())}
+
+    def timeline(self) -> list[Span]:
+        """Raw spans in completion order (bounded by timeline_maxlen)."""
+        return self._rec.spans()
+
+    def chrome_trace(self, process_name: str = "pipeline") -> dict:
+        """Perfetto-loadable Chrome trace-event JSON of the timeline — the
+        offline twin of the serving layer's /debug/trace dump."""
+        from ..obs.export import spans_to_chrome
+
+        return spans_to_chrome(self.timeline(), process_name)
 
     def to_dict(self) -> dict:
         return {"spans": self.stats()}
@@ -96,6 +109,7 @@ class Tracer:
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+        self._rec.clear()
 
 
 @contextlib.contextmanager

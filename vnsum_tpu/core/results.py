@@ -79,21 +79,38 @@ class ServeRequestRecord:
     to the caller). The serving HTTP layer returns these inline with
     responses and the load generator (scripts/bench_serving.py) aggregates
     them, so the same fields serve live debugging and committed benchmark
-    evidence."""
+    evidence.
+
+    ``trace_id`` is the END-TO-END correlation id (vnsum_tpu.obs): the same
+    string rides the X-Request-Id response header, the /debug/trace dump's
+    request track, and log lines — a summarize request's fanned-out prompts
+    all share its trace_id while keeping distinct queue-level request_ids."""
 
     request_id: int
     status: str = "ok"  # ok | error
+    trace_id: str = ""
     queue_wait_s: float = 0.0  # submit -> engine dispatch
     engine_s: float = 0.0      # wall clock of the shared engine batch
     total_s: float = 0.0       # submit -> completion
+    # submit -> first token: queue wait + the batch's prefill phase when the
+    # backend emitted one (obs.BatchTrace.first_token_at), else the whole
+    # engine call — the fused one-shot program has no observable midpoint.
+    # ttft_anchored says which: only anchored values feed the
+    # vnsum_serve_ttft_seconds histogram (an unanchored fallback is just
+    # e2e relabeled and would poison the quantiles)
+    ttft_s: float = 0.0
+    ttft_anchored: bool = False
     batch_size: int = 0        # occupancy of the engine batch it rode
     prompt_tokens: int = 0
     generated_tokens: int = 0
     # reference-guided speculative decoding (vnsum_tpu.spec): per-request
     # drafting/acceptance, attributed from the backend's take_spec_report
-    # hook (all zero when speculation was off for the batch)
+    # hook (all zero when speculation was off for the batch). spec_steps
+    # counts the verify forwards the row was live for — accepted/steps feeds
+    # the vnsum_serve_spec_accepted_per_step histogram
     draft_tokens: int = 0
     accepted_tokens: int = 0
+    spec_steps: int = 0
 
     @property
     def acceptance_rate(self) -> float:

@@ -1,0 +1,125 @@
+"""Chrome trace-event JSON export (Perfetto-loadable timelines).
+
+Serializes the in-memory span model (`obs/trace.py`) into the trace-event
+format both `chrome://tracing` and https://ui.perfetto.dev load directly:
+
+- every **engine batch** becomes its own track under the ``engine`` process
+  (pid 1): the batch slice, with the backend's phase events (prefill /
+  decode segments / spec steps) nested inside it;
+- every **request** becomes its own process (pid 100+): track 0 carries the
+  request-level slice, and each fanned-out prompt's queue-wait/engine/
+  postprocess slices sit on their own sub-track — per-prompt intervals of
+  one request overlap in time, and the trace-event format requires slices
+  on a single track to nest properly, so overlap gets a track, not a stack.
+
+All host timestamps are `time.monotonic()` seconds; export rebases them to
+microseconds from the earliest event so the viewer opens at t=0. Output is a
+plain dict — callers `json.dumps` it (the `/debug/trace` endpoint) or hand
+it to :func:`save_chrome_trace`.
+"""
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+ENGINE_PID = 1
+REQUEST_PID0 = 100
+
+
+def chrome_trace(requests, batches) -> dict:
+    """Build the trace-event dict from finished Request/Batch traces."""
+    # snapshot once: finished traces are sealed, but a shed trace can land
+    # in the ring while a straggler span races the seal — never iterate the
+    # live span lists
+    req_spans = [(r, r.spans_snapshot()) for r in requests]
+    epoch = time.monotonic()
+    for _, spans in req_spans:
+        for sp in spans:
+            epoch = min(epoch, sp.t0)
+    for b in batches:
+        epoch = min(epoch, b.t0)
+    us = lambda t: round((t - epoch) * 1e6, 3)  # noqa: E731
+    ev: list[dict] = []
+
+    def meta(name, pid, tid, value):
+        ev.append({"ph": "M", "name": name, "pid": pid, "tid": tid,
+                   "args": {"name": value}})
+
+    def slice_(name, pid, tid, t0, dur, args=None):
+        e = {"ph": "X", "name": name, "pid": pid, "tid": tid,
+             "ts": us(t0), "dur": round(max(dur, 0.0) * 1e6, 3)}
+        if args:
+            e["args"] = args
+        ev.append(e)
+
+    if batches:
+        meta("process_name", ENGINE_PID, 0, "engine")
+    for b in batches:
+        tid = b.batch_id
+        meta("thread_name", ENGINE_PID, tid, f"batch {b.batch_id}")
+        t1 = b.t1 if b.t1 is not None else b.t0
+        slice_(
+            f"batch[occ={b.occupancy}]", ENGINE_PID, tid, b.t0, t1 - b.t0,
+            {"occupancy": b.occupancy, "gen_tokens": b.gen_tokens},
+        )
+        for sp in b.events:
+            slice_(sp.name, ENGINE_PID, tid, sp.t0, sp.dur, sp.args)
+
+    for i, (r, spans) in enumerate(req_spans):
+        pid = REQUEST_PID0 + i
+        meta("process_name", pid, 0, f"request {r.trace_id}")
+        tracks = {sp.track for sp in spans}
+        for tr in sorted(tracks):
+            meta("thread_name", pid, tr,
+                 "request" if tr == 0 else f"prompt {tr - 1}")
+        for sp in spans:
+            slice_(sp.name, pid, sp.track, sp.t0, sp.dur, sp.args)
+
+    return {"traceEvents": ev, "displayTimeUnit": "ms"}
+
+
+def spans_to_chrome(spans, process_name: str = "pipeline") -> dict:
+    """Export a flat span list (e.g. `core/profiling.Tracer.timeline()`) as
+    one single-process timeline — how offline pipeline runs share the same
+    Perfetto workflow as the serving rings."""
+    epoch = min((sp.t0 for sp in spans), default=0.0)
+    ev: list[dict] = [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+         "args": {"name": process_name}}
+    ]
+    for sp in spans:
+        e = {
+            "ph": "X", "name": sp.name, "pid": 1, "tid": sp.track,
+            "ts": round((sp.t0 - epoch) * 1e6, 3),
+            "dur": round(max(sp.dur, 0.0) * 1e6, 3),
+        }
+        if sp.args:
+            e["args"] = sp.args
+        ev.append(e)
+    return {"traceEvents": ev, "displayTimeUnit": "ms"}
+
+
+def save_chrome_trace(trace: dict, path) -> Path:
+    """Write a trace dict as .json next to any XLA device traces
+    (`core/profiling.device_profile` writes into the same directory when
+    armed), so host spans and device timelines open side by side."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(trace), encoding="utf-8")
+    return p
+
+
+def save_timestamped_trace(trace: dict, directory, prefix: str) -> Path:
+    """THE dump naming policy (serve /debug/trace?save=1, serve shutdown,
+    pipeline runs): <prefix>_trace_<ts>.json in ``directory``, suffixed
+    _1/_2/... instead of silently overwriting when two dumps land within
+    the same second."""
+    d = Path(directory)
+    ts = time.strftime("%Y%m%d_%H%M%S")
+    path = d / f"{prefix}_trace_{ts}.json"
+    n = 1
+    while path.exists():
+        path = d / f"{prefix}_trace_{ts}_{n}.json"
+        n += 1
+    return save_chrome_trace(trace, path)

@@ -1,0 +1,122 @@
+"""Fixed-bucket cumulative histograms with Prometheus text rendering.
+
+The serving metrics (`serve/metrics.py`) were flat counters plus one
+hand-rolled bucket array; this module makes the histogram a first-class,
+reusable unit: ``observe()`` is two integer adds and a float add (no
+allocation — safe on a per-request path), rendering emits the standard
+Prometheus ``_bucket``/``_sum``/``_count`` cumulative text format, and
+``percentile()`` derives p50/p95/p99 from the buckets the way a PromQL
+``histogram_quantile`` would (linear interpolation inside the bucket), so
+bench scripts can snapshot quantiles without retaining raw samples.
+
+Not internally locked: owners that observe from multiple threads
+(`serve/metrics.ServeMetrics`) already serialize under their own lock, and a
+second lock per observation would be pure overhead.
+"""
+from __future__ import annotations
+
+
+# shared bucket ladders (seconds unless noted). Spans are chosen to cover
+# sub-millisecond coalescing waits through multi-second strategy runs; the
+# serving metrics registry in serve/metrics.py maps names -> ladders.
+WAIT_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                  1.0, 2.5, 5.0)
+TTFT_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                  10.0)
+E2E_BUCKETS_S = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                 30.0, 60.0)
+OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+ACCEPT_BUCKETS = (0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus-style number: integral values without the trailing .0."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class Histogram:
+    """Cumulative fixed-bucket histogram (Prometheus semantics).
+
+    ``counts[i]`` is the NON-cumulative count of observations in
+    ``(bounds[i-1], bounds[i]]``; the final slot is the +Inf tail. Rendering
+    accumulates, matching the ``le``-labelled cumulative contract scrapers
+    expect.
+    """
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds) -> None:
+        b = tuple(float(x) for x in bounds)
+        if not b or list(b) != sorted(b):
+            raise ValueError("bucket bounds must be non-empty and ascending")
+        self.bounds = b
+        self.counts = [0] * (len(b) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, ub in enumerate(self.bounds):
+            if value <= ub:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def percentile(self, q: float) -> float:
+        """Quantile estimate from the buckets (histogram_quantile rules):
+        find the bucket where the cumulative count crosses ``q * count``,
+        interpolate linearly inside it. Observations in the +Inf tail report
+        the highest finite bound — a floor, exactly like PromQL."""
+        if not self.count:
+            return 0.0
+        rank = q * self.count
+        cum = 0
+        lo = 0.0
+        for i, ub in enumerate(self.bounds):
+            prev = cum
+            cum += self.counts[i]
+            if cum >= rank:
+                frac = (rank - prev) / self.counts[i] if self.counts[i] else 0.0
+                return lo + (ub - lo) * frac
+            lo = ub
+        return self.bounds[-1]
+
+    # -- export ----------------------------------------------------------
+
+    def render(self, name: str, help_: str) -> list[str]:
+        """Prometheus text-format lines: HELP/TYPE then cumulative
+        ``_bucket{le=...}`` rows, ``_sum``, ``_count``."""
+        lines = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+        cum = 0
+        for ub, n in zip(self.bounds, self.counts):
+            cum += n
+            lines.append(f'{name}_bucket{{le="{_fmt(ub)}"}} {cum}')
+        cum += self.counts[-1]
+        lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{name}_sum {round(self.sum, 6)}")
+        lines.append(f"{name}_count {cum}")
+        return lines
+
+    def to_dict(self) -> dict:
+        """Snapshot for bench JSON: buckets plus derived p50/p95/p99 — the
+        quantiles BENCH_*.json files report instead of bare means."""
+        return {
+            "buckets": {
+                **{_fmt(ub): n for ub, n in zip(self.bounds, self.counts)},
+                "+Inf": self.counts[-1],
+            },
+            "sum": round(self.sum, 6),
+            "count": self.count,
+            "p50": round(self.percentile(0.50), 6),
+            "p95": round(self.percentile(0.95), 6),
+            "p99": round(self.percentile(0.99), 6),
+        }
+
+    def copy(self) -> "Histogram":
+        h = Histogram(self.bounds)
+        h.counts = list(self.counts)
+        h.sum = self.sum
+        h.count = self.count
+        return h

@@ -11,6 +11,7 @@ device batches.
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 import traceback
 from pathlib import Path
@@ -497,6 +498,18 @@ class PipelineRunner:
         self.results.tracing = self.tracer.to_dict()
         path = self.results.save(self.config.results_dir)
         logger.info("results saved to %s", path)
+        # when device profiling is armed (VNSUM_PROFILE_DIR), drop the host
+        # span timeline as Chrome trace JSON into the same directory so the
+        # pipeline's wall-clock phases open in Perfetto next to the XLA
+        # device trace — the offline twin of serving's /debug/trace
+        profile_dir = os.environ.get("VNSUM_PROFILE_DIR")
+        if profile_dir:
+            from ..obs.export import save_timestamped_trace
+
+            tp = save_timestamped_trace(
+                self.tracer.chrome_trace("pipeline"), profile_dir, "pipeline"
+            )
+            logger.info("host span timeline saved to %s", tp)
         self.report()
         return self.results
 

@@ -6,14 +6,22 @@ the exact serial-request shape the reference's Ollama loop had (PAPER.md §7).
 This package is the missing online front-end for the batched engine:
 
 - queue.py      bounded async request queue: per-request deadlines, typed
-                429-style admission control (queue depth + token budget)
+                429-style admission control (queue depth + token budget);
+                requests carry their end-to-end trace_id and RequestTrace
+                across the thread handoff
 - scheduler.py  micro-batching scheduler thread that coalesces queued
                 requests into shared engine batches (max-wait/max-batch
                 policy), plus the QueuedBackend adapter that lets the
-                existing strategies submit their rounds through the queue
-- metrics.py    per-request + aggregate observability, Prometheus text
+                existing strategies submit their rounds through the queue;
+                installs the obs BatchTrace collector around each engine
+                dispatch and derives per-request TTFT from its prefill end
+- metrics.py    per-request + aggregate observability: counters, rolling
+                gauges, and fixed-bucket histograms (queue wait / TTFT /
+                e2e / occupancy / accepted-per-step) in Prometheus text;
+                ONE metric registry, linted against the README table
 - server.py     stdlib HTTP front-end: /v1/summarize, /v1/generate,
-                /healthz, /metrics  (python -m vnsum_tpu.serve.server)
+                /healthz, /metrics, /debug/trace (Perfetto-loadable
+                Chrome trace JSON)  (python -m vnsum_tpu.serve.server)
 
 The engine itself is untouched: ONE scheduler thread owns all
 backend.generate calls (TpuBackend's jit caches and stats are not

@@ -1,33 +1,123 @@
-"""Serving observability: thread-safe counters + Prometheus text export.
+"""Serving observability: counters, histograms, rolling gauges + Prometheus
+text export.
 
-Two consumption surfaces off one data structure:
-- GET /metrics renders the Prometheus text format (counters, gauges, and a
-  cumulative histogram for queue wait), so a scrape loop sees queue wait,
-  batch occupancy, time-in-engine, tokens/s, and shed counts per reason;
+Three consumption surfaces off one locked data structure:
+
+- GET /metrics renders the Prometheus text format — counters/gauges plus
+  fixed-bucket histograms (``_bucket``/``_sum``/``_count``) for queue wait,
+  TTFT, end-to-end latency, batch occupancy, and accepted-drafts-per-step
+  (`obs/histogram.py`);
 - snapshot() returns a core.results.ServingStats so run records and the
-  serving benchmark embed the same numbers the scrape endpoint reports —
-  one source of truth, two serializations.
+  serving benchmark embed the same numbers the scrape endpoint reports;
+- histograms_snapshot() exposes the bucket state with bucket-derived
+  p50/p95/p99, which `scripts/bench_serving.py` / `scripts/bench_spec_ab.py`
+  write into their BENCH_*.json instead of bare means.
+
+Metric registry: every exported metric is declared ONCE in the `_reg(...)`
+block below — rendering takes its HELP/TYPE text from the registry, and
+`metric_names()` feeds `scripts/check_metrics_doc.py`, the CI lint that
+fails when a registered metric is missing from the README observability
+table. Registration lines keep literal string names so the lint can parse
+this file without importing it.
+
+Emission sites for the registry entries: request/shed/batch counters and all
+histograms are observed by `serve/scheduler.py` (observe_submit via the
+queue's on_admit hook, observe_shed, observe_batch, observe_request);
+queue_depth/queued_tokens gauges are read from the live RequestQueue at
+scrape time by `serve/server.py`.
 """
 from __future__ import annotations
 
 import threading
 
 from ..core.results import ServeRequestRecord, ServingStats
+from ..obs.histogram import (
+    ACCEPT_BUCKETS,
+    E2E_BUCKETS_S,
+    Histogram,
+    OCCUPANCY_BUCKETS,
+    TTFT_BUCKETS_S,
+    WAIT_BUCKETS_S,
+)
+from ..obs.telemetry import Rolling
 from .queue import ShedReason
 
-# cumulative histogram bucket upper bounds (seconds) for queue wait — spans
-# sub-millisecond coalescing waits through multi-second overload backlogs
-_WAIT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+_PREFIX = "vnsum_serve_"
+_METRICS: dict[str, tuple[str, str]] = {}  # short name -> (type, help)
+
+
+def _reg(name: str, typ: str, help_: str) -> str:
+    _METRICS[name] = (typ, help_)
+    return name
+
+
+# -- the ONE metric registry (names literal for the CI doc lint) -------------
+_reg("requests_total", "counter", "requests admitted to the queue")
+_reg("requests_completed_total", "counter", "requests answered")
+_reg("requests_errored_total", "counter", "requests failed in the engine")
+_reg("requests_shed_total", "counter", "requests shed, by reason")
+_reg("batches_total", "counter", "engine batches dispatched")
+_reg("batch_occupancy_sum", "counter",
+     "sum of engine batch occupancies (avg = sum / batches_total)")
+_reg("engine_seconds_total", "counter",
+     "wall-clock seconds spent inside backend.generate")
+_reg("queue_wait_seconds_total", "counter",
+     "total seconds requests spent queued before dispatch")
+_reg("prompt_tokens_total", "counter", "prompt tokens admitted")
+_reg("generated_tokens_total", "counter", "tokens generated")
+_reg("tokens_per_second", "gauge",
+     "cumulative (prompt+generated) tokens / engine second")
+_reg("tokens_per_second_rolling", "gauge",
+     "generated tokens / engine second over the last 256 batches")
+_reg("spec_draft_tokens_total", "counter",
+     "tokens proposed by the speculative drafter")
+_reg("spec_accepted_tokens_total", "counter",
+     "drafted tokens the model accepted at verification")
+_reg("spec_acceptance_rate", "gauge",
+     "cumulative accepted / drafted tokens (0 when spec is off)")
+_reg("spec_acceptance_rolling", "gauge",
+     "accepted / drafted tokens over the last 256 requests")
+_reg("queue_depth", "gauge", "requests currently queued")
+_reg("queued_tokens", "gauge", "prompt-token estimate currently queued")
+_reg("queue_wait_seconds", "histogram",
+     "queue wait (submit -> engine dispatch)")
+_reg("ttft_seconds", "histogram",
+     "time to first token (submit -> end of the batch's prefill phase); "
+     "observed only for requests whose batch emitted a prefill anchor, so "
+     "counts can trail e2e_seconds when tracing is off")
+_reg("e2e_seconds", "histogram",
+     "end-to-end request latency (submit -> completion)")
+_reg("batch_occupancy", "histogram", "engine batch occupancy at dispatch")
+_reg("spec_accepted_per_step", "histogram",
+     "accepted draft tokens per verify step, per request")
+
+
+def metric_names(full: bool = True) -> list[str]:
+    """Registered metric names (prefixed by default) — the doc-lint surface."""
+    return [(_PREFIX + n if full else n) for n in _METRICS]
 
 
 class ServeMetrics:
-    """Aggregate counters; observe_* methods are called from the scheduler
-    thread and the HTTP handler threads, so everything locks."""
+    """Aggregate counters + histograms; observe_* methods are called from the
+    scheduler thread and the HTTP handler threads, so everything locks.
+
+    Histograms and rolling windows are always on — a handful of integer adds
+    per REQUEST (never per token), which is why they need no sampling gate;
+    the pricier per-span tracing lives in obs.ObsHub behind --trace-sample.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._stats = ServingStats()
-        self._wait_buckets = [0] * (len(_WAIT_BUCKETS) + 1)  # +inf tail
+        self._hists = {
+            "queue_wait_seconds": Histogram(WAIT_BUCKETS_S),
+            "ttft_seconds": Histogram(TTFT_BUCKETS_S),
+            "e2e_seconds": Histogram(E2E_BUCKETS_S),
+            "batch_occupancy": Histogram(OCCUPANCY_BUCKETS),
+            "spec_accepted_per_step": Histogram(ACCEPT_BUCKETS),
+        }
+        self._rolling_accept = Rolling(256)
+        self._rolling_tps = Rolling(256)
 
     # -- observation hooks ----------------------------------------------
 
@@ -40,11 +130,14 @@ class ServeMetrics:
             key = reason.value
             self._stats.shed[key] = self._stats.shed.get(key, 0) + n
 
-    def observe_batch(self, occupancy: int, engine_s: float) -> None:
+    def observe_batch(self, occupancy: int, engine_s: float,
+                      gen_tokens: int = 0) -> None:
         with self._lock:
             self._stats.batches += 1
             self._stats.batch_occupancy_sum += occupancy
             self._stats.engine_seconds += engine_s
+            self._hists["batch_occupancy"].observe(occupancy)
+            self._rolling_tps.add(gen_tokens, engine_s)
 
     def observe_request(self, rec: ServeRequestRecord) -> None:
         with self._lock:
@@ -57,12 +150,20 @@ class ServeMetrics:
             self._stats.generated_tokens += rec.generated_tokens
             self._stats.draft_tokens += rec.draft_tokens
             self._stats.accepted_tokens += rec.accepted_tokens
-            for i, ub in enumerate(_WAIT_BUCKETS):
-                if rec.queue_wait_s <= ub:
-                    self._wait_buckets[i] += 1
-                    break
-            else:
-                self._wait_buckets[-1] += 1
+            self._hists["queue_wait_seconds"].observe(rec.queue_wait_s)
+            if rec.status == "ok":
+                # only anchored TTFT (a real prefill-end timestamp from the
+                # batch trace) enters the histogram: the unanchored fallback
+                # equals e2e and would silently poison the quantiles
+                if rec.ttft_anchored:
+                    self._hists["ttft_seconds"].observe(rec.ttft_s)
+                self._hists["e2e_seconds"].observe(rec.total_s)
+            if rec.draft_tokens:
+                self._rolling_accept.add(rec.accepted_tokens, rec.draft_tokens)
+            if rec.spec_steps:
+                self._hists["spec_accepted_per_step"].observe(
+                    rec.accepted_tokens / rec.spec_steps
+                )
 
     # -- export ----------------------------------------------------------
 
@@ -72,72 +173,58 @@ class ServeMetrics:
         with self._lock:
             return copy.deepcopy(self._stats)
 
+    def histograms_snapshot(self) -> dict:
+        """{name: {buckets, sum, count, p50, p95, p99}} for bench JSON."""
+        with self._lock:
+            return {k: h.to_dict() for k, h in self._hists.items()}
+
     def render_prometheus(self, queue_depth: int | None = None,
                           queued_tokens: int | None = None) -> str:
         import copy
 
-        # one lock acquisition for stats AND buckets: a scrape must not see
-        # a histogram count that disagrees with the counters it shipped with
+        # one lock acquisition for stats AND histograms: a scrape must not
+        # see a histogram count that disagrees with the counters it shipped
+        # with
         with self._lock:
             s = copy.deepcopy(self._stats)
-            buckets = list(self._wait_buckets)
+            hists = {k: h.copy() for k, h in self._hists.items()}
+            rolling_accept = self._rolling_accept.rate()
+            rolling_tps = self._rolling_tps.rate()
         lines = []
 
-        def counter(name, value, help_, labels=""):
-            lines.append(f"# HELP vnsum_serve_{name} {help_}")
-            lines.append(f"# TYPE vnsum_serve_{name} counter")
-            lines.append(f"vnsum_serve_{name}{labels} {value}")
+        def simple(name, value):
+            typ, help_ = _METRICS[name]  # KeyError = unregistered metric
+            lines.append(f"# HELP {_PREFIX}{name} {help_}")
+            lines.append(f"# TYPE {_PREFIX}{name} {typ}")
+            lines.append(f"{_PREFIX}{name} {value}")
 
-        def gauge(name, value, help_):
-            lines.append(f"# HELP vnsum_serve_{name} {help_}")
-            lines.append(f"# TYPE vnsum_serve_{name} gauge")
-            lines.append(f"vnsum_serve_{name} {value}")
-
-        counter("requests_total", s.submitted, "requests admitted to the queue")
-        counter("requests_completed_total", s.completed, "requests answered")
-        counter("requests_errored_total", s.errors, "requests failed in the engine")
-        lines.append("# HELP vnsum_serve_requests_shed_total requests shed, by reason")
-        lines.append("# TYPE vnsum_serve_requests_shed_total counter")
+        simple("requests_total", s.submitted)
+        simple("requests_completed_total", s.completed)
+        simple("requests_errored_total", s.errors)
+        typ, help_ = _METRICS["requests_shed_total"]
+        lines.append(f"# HELP {_PREFIX}requests_shed_total {help_}")
+        lines.append(f"# TYPE {_PREFIX}requests_shed_total {typ}")
         for reason in ShedReason:
             lines.append(
-                f'vnsum_serve_requests_shed_total{{reason="{reason.value}"}} '
+                f'{_PREFIX}requests_shed_total{{reason="{reason.value}"}} '
                 f"{s.shed.get(reason.value, 0)}"
             )
-        counter("batches_total", s.batches, "engine batches dispatched")
-        counter("batch_occupancy_sum", s.batch_occupancy_sum,
-                "sum of engine batch occupancies (avg = sum / batches_total)")
-        counter("engine_seconds_total", round(s.engine_seconds, 6),
-                "wall-clock seconds spent inside backend.generate")
-        counter("queue_wait_seconds_total", round(s.queue_wait_seconds, 6),
-                "total seconds requests spent queued before dispatch")
-        counter("prompt_tokens_total", s.prompt_tokens, "prompt tokens admitted")
-        counter("generated_tokens_total", s.generated_tokens, "tokens generated")
-        gauge("tokens_per_second", round(s.tokens_per_second, 3),
-              "cumulative (prompt+generated) tokens / engine second")
-        counter("spec_draft_tokens_total", s.draft_tokens,
-                "tokens proposed by the speculative drafter")
-        counter("spec_accepted_tokens_total", s.accepted_tokens,
-                "drafted tokens the model accepted at verification")
-        gauge("spec_acceptance_rate", round(s.acceptance_rate, 6),
-              "cumulative accepted / drafted tokens (0 when spec is off)")
+        simple("batches_total", s.batches)
+        simple("batch_occupancy_sum", s.batch_occupancy_sum)
+        simple("engine_seconds_total", round(s.engine_seconds, 6))
+        simple("queue_wait_seconds_total", round(s.queue_wait_seconds, 6))
+        simple("prompt_tokens_total", s.prompt_tokens)
+        simple("generated_tokens_total", s.generated_tokens)
+        simple("tokens_per_second", round(s.tokens_per_second, 3))
+        simple("tokens_per_second_rolling", round(rolling_tps, 3))
+        simple("spec_draft_tokens_total", s.draft_tokens)
+        simple("spec_accepted_tokens_total", s.accepted_tokens)
+        simple("spec_acceptance_rate", round(s.acceptance_rate, 6))
+        simple("spec_acceptance_rolling", round(rolling_accept, 6))
         if queue_depth is not None:
-            gauge("queue_depth", queue_depth, "requests currently queued")
+            simple("queue_depth", queue_depth)
         if queued_tokens is not None:
-            gauge("queued_tokens", queued_tokens,
-                  "prompt-token estimate currently queued")
-
-        lines.append("# HELP vnsum_serve_queue_wait_seconds queue wait histogram")
-        lines.append("# TYPE vnsum_serve_queue_wait_seconds histogram")
-        cum = 0
-        for ub, n in zip(_WAIT_BUCKETS, buckets):
-            cum += n
-            lines.append(
-                f'vnsum_serve_queue_wait_seconds_bucket{{le="{ub}"}} {cum}'
-            )
-        cum += buckets[-1]
-        lines.append(f'vnsum_serve_queue_wait_seconds_bucket{{le="+Inf"}} {cum}')
-        lines.append(
-            f"vnsum_serve_queue_wait_seconds_sum {round(s.queue_wait_seconds, 6)}"
-        )
-        lines.append(f"vnsum_serve_queue_wait_seconds_count {cum}")
+            simple("queued_tokens", queued_tokens)
+        for name, h in hists.items():
+            lines.extend(h.render(_PREFIX + name, _METRICS[name][1]))
         return "\n".join(lines) + "\n"

@@ -66,8 +66,26 @@ class ServeRequest:
     deadline: float | None = None
     est_tokens: int = 0
     request_id: int = field(default_factory=lambda: next(_ids))
+    # end-to-end correlation id (vnsum_tpu.obs): defaults to a queue-derived
+    # id in __post_init__; the HTTP layer overrides it with the client's
+    # X-Request-Id so one id links response header, logs, and /debug/trace.
+    # Fanned-out prompts of one request share a trace_id but keep their own
+    # request_id — per-ROW metadata, never part of batch_key
+    trace_id: str = ""
+    # the shared RequestTrace this row's spans land on (None = untraced) and
+    # this row's sub-track within it; set by the scheduler at submit
+    trace: object | None = field(default=None, repr=False, compare=False)
+    trace_track: int = 0
+    # scheduler-owned trace lifecycle: True when the scheduler created the
+    # trace at submit (no HTTP layer to finalize it) and must finish it on
+    # completion
+    own_trace: bool = False
     enqueued_at: float = field(default_factory=time.monotonic)
     future: Future = field(default_factory=Future)
+
+    def __post_init__(self) -> None:
+        if not self.trace_id:
+            self.trace_id = f"req-{self.request_id}"
 
     def batch_key(self) -> tuple:
         """Requests sharing this key can ride one engine batch: the engine

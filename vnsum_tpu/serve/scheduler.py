@@ -30,6 +30,7 @@ strategies knowing the serving layer exists.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -37,6 +38,7 @@ from ..backend.base import Backend
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
 from ..core.results import ServeRequestRecord
+from ..obs import ObsHub, RequestTrace, reset_collector, set_collector
 from .metrics import ServeMetrics
 from .queue import RequestQueue, RequestShed, ServeRequest, ShedReason
 
@@ -64,6 +66,8 @@ class MicroBatchScheduler:
         max_queue_depth: int = 256,
         max_queued_tokens: int = 0,
         metrics: ServeMetrics | None = None,
+        obs: ObsHub | None = None,
+        trace_dir: str | None = None,
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -71,6 +75,18 @@ class MicroBatchScheduler:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.metrics = metrics or ServeMetrics()
+        # tracing hub (vnsum_tpu.obs): None = tracing fully off — the hot
+        # path then pays only `is None` checks, no allocation, no contextvar
+        # writes (the < 2% overhead guarantee in tests/test_obs_serve.py)
+        self.obs = obs
+        # --trace-dir: host Chrome traces are dumped here by the server, and
+        # the FIRST dispatched batch is wrapped in core.profiling.device_profile
+        # so one XLA device trace lands side by side with the host spans.
+        # That first batch pays the capture cost — trivial on a TPU backend
+        # (jax is warm), but ~10s of cold jax import on a FakeBackend dev
+        # server — so the capture is one-shot, never per batch
+        self._trace_dir = trace_dir
+        self._profile_pending = trace_dir is not None
         self.queue = RequestQueue(
             max_depth=max_queue_depth, max_queued_tokens=max_queued_tokens
         )
@@ -93,6 +109,9 @@ class MicroBatchScheduler:
         deadline: float | None = None,
         internal: bool = False,
         reference: str | None = None,
+        trace: RequestTrace | None = None,
+        trace_id: str | None = None,
+        trace_owned: bool = False,
     ):
         """Admit one prompt; returns a Future resolving to a _Completion.
         Raises RequestShed synchronously when admission control rejects.
@@ -100,7 +119,19 @@ class MicroBatchScheduler:
         rounds riding a QueuedBackend): depth/token admission is skipped —
         the request-level gate is check_admission — while deadline and
         shutdown shedding still apply. ``reference`` rides the request as
-        per-row speculation metadata (never part of the batch key)."""
+        per-row speculation metadata (never part of the batch key).
+
+        Tracing: an entry point that already owns a RequestTrace (the HTTP
+        layer, a strategy's QueuedBackend) passes it via ``trace`` — this
+        prompt claims one sub-track on it and the owner finalizes it.
+        ``trace_owned=True`` says the caller made the SAMPLING decision,
+        whatever it was: with trace=None it means "sampled out", and the
+        scheduler must not re-draw per fanned-out prompt (which would both
+        distort the configured rate and fragment one request into
+        single-prompt traces). Only a bare submit (no owner, ObsHub
+        configured) samples here, so direct API users get timelines too.
+        ``trace_id`` overrides the queue-derived correlation id either
+        way."""
         req = ServeRequest(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -108,7 +139,16 @@ class MicroBatchScheduler:
             reference=reference,
             deadline=deadline,
             est_tokens=self.backend.count_tokens(prompt),
+            trace_id=trace_id or "",
         )
+        if trace is not None:
+            req.trace = trace
+            req.trace_track = trace.next_track()
+        elif not trace_owned and self.obs is not None:
+            t = self.obs.start_request(req.trace_id)
+            if t is not None:
+                req.trace, req.own_trace = t, True
+                req.trace_track = t.next_track()
         # the admit is counted by the queue's on_admit hook, under the queue
         # lock, so metrics can never show a completion before its submit
         return self.queue.submit(req, force=internal)  # raises RequestShed
@@ -145,23 +185,39 @@ class MicroBatchScheduler:
         deadline: float | None = None,
         internal: bool = False,
         references: list[str | None] | None = None,
+        trace: RequestTrace | None = None,
+        trace_id: str | None = None,
+        trace_owned: bool = False,
     ) -> list[_Completion]:
         futs = self.submit_many(
             prompts, references=references, max_new_tokens=max_new_tokens,
             config=config, deadline=deadline, internal=internal,
+            trace=trace, trace_id=trace_id, trace_owned=trace_owned,
         )
         return [f.result() for f in futs]
 
-    def backend_view(self, deadline: float | None = None) -> "QueuedBackend":
+    def backend_view(
+        self,
+        deadline: float | None = None,
+        trace: RequestTrace | None = None,
+        trace_id: str | None = None,
+    ) -> "QueuedBackend":
         """A Backend-protocol view whose generate() routes through this
         scheduler — hand it to a strategy to make its rounds coalesce with
-        everyone else's."""
-        return QueuedBackend(self, deadline=deadline)
+        everyone else's. A ``trace`` makes every round's prompt record its
+        spans on that ONE request timeline (per-prompt sub-tracks)."""
+        return QueuedBackend(self, deadline=deadline, trace=trace,
+                             trace_id=trace_id)
 
     # -- scheduler thread ------------------------------------------------
 
     def _on_shed(self, req: ServeRequest, reason: ShedReason) -> None:
         self.metrics.observe_shed(reason)
+        # scheduler-owned traces must not leak open on the shed path; the
+        # hub lock is independent of the queue lock this hook runs under
+        if req.own_trace and req.trace is not None and self.obs is not None:
+            self.obs.finish_request(req.trace, f"shed:{reason.value}")
+            req.trace = None
 
     def _loop(self) -> None:
         while True:
@@ -186,26 +242,40 @@ class MicroBatchScheduler:
 
     def _run_batch(self, batch: list[ServeRequest]) -> None:
         head = batch[0]
+        # batch telemetry (vnsum_tpu.obs): the BatchTrace is installed as the
+        # contextvar collector for the duration of backend.generate, so the
+        # engine's prefill/decode/spec-step emits land on THIS batch's track
+        # and its prefill end anchors every rider's TTFT
+        bt = self.obs.start_batch(len(batch)) if self.obs is not None else None
+        profile_cm = contextlib.nullcontext()
+        if self._profile_pending:
+            # one-shot: the first dispatched batch also captures an XLA
+            # device profile into --trace-dir, side by side with host spans
+            self._profile_pending = False
+            from ..core.profiling import device_profile
+
+            profile_cm = device_profile(self._trace_dir)
+        token = set_collector(bt) if bt is not None else None
         t0 = time.monotonic()
         try:
-            outs = self.backend.generate(
-                [r.prompt for r in batch],
-                max_new_tokens=head.max_new_tokens,
-                config=head.config,
-                references=[r.reference for r in batch],
-            )
+            with profile_cm:
+                outs = self.backend.generate(
+                    [r.prompt for r in batch],
+                    max_new_tokens=head.max_new_tokens,
+                    config=head.config,
+                    references=[r.reference for r in batch],
+                )
         except Exception as e:
             engine_s = time.monotonic() - t0
+            self._finish_batch_trace(bt, 0)
             self.metrics.observe_batch(len(batch), engine_s)
             logger.exception("engine batch of %d failed", len(batch))
-            for r in batch:
-                rec = self._record(r, "error", t0, engine_s, len(batch), 0)
-                self.metrics.observe_request(rec)
-                if not r.future.done():
-                    r.future.set_exception(e)
+            self._resolve_errored(batch, e, t0, engine_s, bt)
             return
+        finally:
+            if token is not None:
+                reset_collector(token)
         engine_s = time.monotonic() - t0
-        self.metrics.observe_batch(len(batch), engine_s)
         if len(outs) != len(batch):
             # a zip would silently drop the tail and strand its futures
             e = RuntimeError(
@@ -213,13 +283,13 @@ class MicroBatchScheduler:
                 f"{len(batch)}"
             )
             logger.error(str(e))
-            for r in batch:
-                rec = self._record(r, "error", t0, engine_s, len(batch), 0)
-                self.metrics.observe_request(rec)
-                if not r.future.done():
-                    r.future.set_exception(e)
+            self._finish_batch_trace(bt, 0)
+            self.metrics.observe_batch(len(batch), engine_s)
+            self._resolve_errored(batch, e, t0, engine_s, bt)
             return
         gen_tokens = self.backend.count_tokens_batch(outs)
+        self._finish_batch_trace(bt, sum(gen_tokens))
+        self.metrics.observe_batch(len(batch), engine_s, sum(gen_tokens))
         # per-request speculative-decoding attribution: backends with the
         # spec path expose take_spec_report() — per-prompt records aligned
         # with the batch, cleared on read. Engine access is single-threaded
@@ -229,22 +299,68 @@ class MicroBatchScheduler:
         if len(spec_report) != len(batch):
             spec_report = [None] * len(batch)
         for r, out, n_out, spec in zip(batch, outs, gen_tokens, spec_report):
-            rec = self._record(r, "ok", t0, engine_s, len(batch), n_out)
+            rec = self._record(r, "ok", t0, engine_s, len(batch), n_out, bt)
             if spec is not None:
                 rec.draft_tokens = spec.draft_tokens
                 rec.accepted_tokens = spec.accepted_tokens
+                rec.spec_steps = spec.verify_steps
             self.metrics.observe_request(rec)
+            self._trace_request(r, t0, engine_s, bt, "ok")
             if not r.future.done():
                 r.future.set_result(_Completion(out, rec))
 
-    def _record(self, r, status, t0, engine_s, batch_size, gen_tokens):
+    def _resolve_errored(self, batch, e, t0, engine_s, bt) -> None:
+        for r in batch:
+            rec = self._record(r, "error", t0, engine_s, len(batch), 0, bt)
+            self.metrics.observe_request(rec)
+            self._trace_request(r, t0, engine_s, bt, "error")
+            if not r.future.done():
+                r.future.set_exception(e)
+
+    def _finish_batch_trace(self, bt, gen_tokens: int) -> None:
+        if bt is not None:
+            self.obs.finish_batch(bt, gen_tokens)
+
+    def _trace_request(self, r: ServeRequest, t0: float, engine_s: float,
+                       bt, status: str) -> None:
+        """Append this dispatch's spans to the request's trace: queue wait,
+        engine residency (tagged with the batch it rode), postprocess
+        (detokenize-side token counting + record assembly). One call per
+        (request, batch) — a summarize request accumulates one span triple
+        per strategy-round prompt, each on its own sub-track."""
+        tr = r.trace
+        if tr is None:
+            return
+        track = r.trace_track
+        t1 = t0 + engine_s
+        tr.add("queue_wait", r.enqueued_at, max(t0 - r.enqueued_at, 0.0),
+               track, request_id=r.request_id)
+        tr.add("engine", t0, engine_s, track, status=status,
+               batch=bt.batch_id if bt is not None else None,
+               occupancy=bt.occupancy if bt is not None else None)
+        tr.add("postprocess", t1, max(time.monotonic() - t1, 0.0), track)
+        if r.own_trace and self.obs is not None:
+            self.obs.finish_request(tr, status)
+
+    def _record(self, r, status, t0, engine_s, batch_size, gen_tokens,
+                bt=None):
         now = time.monotonic()
+        # TTFT anchor: the batch's host-observed prefill end when the
+        # backend emitted one; the fused one-shot program has no observable
+        # midpoint, so the whole engine call is the honest upper bound —
+        # reported in the record but EXCLUDED from the TTFT histogram
+        # (metrics.observe_request keys on ttft_anchored)
+        anchored = bt is not None and bt.first_token_at is not None
+        first_token = bt.first_token_at if anchored else t0 + engine_s
         return ServeRequestRecord(
             request_id=r.request_id,
             status=status,
+            trace_id=r.trace_id,
             queue_wait_s=max(t0 - r.enqueued_at, 0.0),
             engine_s=engine_s,
             total_s=max(now - r.enqueued_at, 0.0),
+            ttft_s=max(first_token - r.enqueued_at, 0.0),
+            ttft_anchored=anchored,
             batch_size=batch_size,
             prompt_tokens=r.est_tokens,
             generated_tokens=gen_tokens,
@@ -284,9 +400,16 @@ class QueuedBackend:
     name = "queued"
 
     def __init__(self, scheduler: MicroBatchScheduler,
-                 deadline: float | None = None) -> None:
+                 deadline: float | None = None,
+                 trace: RequestTrace | None = None,
+                 trace_id: str | None = None) -> None:
         self.scheduler = scheduler
         self.deadline = deadline
+        # ONE RequestTrace for the whole strategy run: every round's prompts
+        # claim sub-tracks on it, so /debug/trace shows a summarize request
+        # as one process with its map/collapse fan-out side by side
+        self.trace = trace
+        self.trace_id = trace_id
         self.records: list[ServeRequestRecord] = []
         self._lock = threading.Lock()
 
@@ -303,9 +426,12 @@ class QueuedBackend:
         # internal: this is the fan-out of an already-admitted request —
         # its admission happened at the entry point (check_admission), so a
         # wide strategy round must not shed itself against the depth budget
+        # trace_owned: the entry point that built this view decided the
+        # sampling — a trace=None here means "sampled out", not "re-draw"
         completions = self.scheduler.generate_sync(
             prompts, max_new_tokens=max_new_tokens, config=config,
             deadline=self.deadline, internal=True, references=references,
+            trace=self.trace, trace_id=self.trace_id, trace_owned=True,
         )
         with self._lock:
             self.records.extend(c.record for c in completions)

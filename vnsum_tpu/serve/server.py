@@ -7,16 +7,26 @@ TPU hosts with no extra packages).
 
 Endpoints:
     POST /v1/summarize  {"text": ..., "approach": "mapreduce",
-                         "deadline_ms"?, "max_new_tokens"?}
+                         "deadline_ms"?, "max_new_tokens"?, "request_id"?}
         Full strategy run. The strategy's rounds are submitted through the
         micro-batching scheduler, so concurrent summarize requests share
         engine batches.
     POST /v1/generate   {"prompt": str} | {"prompts": [str, ...]},
                         optional "max_new_tokens", "temperature", "top_k",
-                        "top_p", "seed", "deadline_ms"
+                        "top_p", "seed", "deadline_ms", "request_id"
         Raw engine call(s) through the queue.
     GET /healthz        liveness + queue depth
-    GET /metrics        Prometheus text (serve/metrics.py)
+    GET /metrics        Prometheus text (serve/metrics.py): counters plus
+                        queue-wait/TTFT/e2e/occupancy/spec histograms
+    GET /debug/trace    Chrome trace-event JSON of the recent-request ring
+                        (vnsum_tpu.obs) — load in ui.perfetto.dev; one track
+                        per request, one per engine batch. ?save=1 also
+                        writes the dump into --trace-dir.
+
+Request correlation: every response carries an ``X-Request-Id`` header and a
+``request_id`` JSON field — client-supplied (JSON "request_id" or an
+X-Request-Id request header) or generated — and the same id names the
+request's track in /debug/trace and its ServeRequestRecord.trace_id.
 
 Sheds (queue full, token budget, deadline, shutdown) return HTTP 429 with a
 typed JSON body {"error": "shed", "reason": "<queue_full|...>"} — the
@@ -32,12 +42,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..backend.base import Backend, get_backend
 from ..core.config import APPROACHES, GenerationConfig, PipelineConfig, approach_defaults
 from ..core.logging import get_logger
+from ..obs import ObsHub
+from ..obs.export import save_timestamped_trace
 from ..strategies import get_strategy
 from ..text import clean_thinking_tokens
 from .queue import RequestShed
@@ -60,17 +74,36 @@ class ServeState:
         max_queued_tokens: int = 0,
         default_deadline_s: float | None = None,
         default_spec_k: int = 0,
+        trace_sample: float = 1.0,
+        trace_ring: int = 256,
+        trace_dir: str | None = None,
     ) -> None:
         self.backend = backend
         # mirrors the backend's GenerationConfig(spec_k=...) default so a
         # request-built config (which REPLACES the backend default) keeps it
         self.default_spec_k = default_spec_k
+        # tracing (vnsum_tpu.obs): trace_sample=0 disables it outright — no
+        # hub, no RequestTrace allocations, `is None` checks only (the
+        # serving-bench <2% overhead criterion runs in that mode). The
+        # always-on histograms in serve/metrics.py are independent of this.
+        self.obs = (
+            ObsHub(sample=trace_sample, ring=trace_ring)
+            if trace_sample > 0 else None
+        )
+        self.trace_dir = trace_dir
+        if trace_dir:
+            # arm the existing device-profile hook (core/profiling.py): any
+            # device_profile() call in this process now lands its XLA trace
+            # next to the Chrome dumps written here
+            os.environ.setdefault("VNSUM_PROFILE_DIR", trace_dir)
         self.scheduler = MicroBatchScheduler(
             backend,
             max_batch=max_batch,
             max_wait_s=max_wait_s,
             max_queue_depth=max_queue_depth,
             max_queued_tokens=max_queued_tokens,
+            obs=self.obs,
+            trace_dir=trace_dir,
         )
         self.default_deadline_s = default_deadline_s
         self._strategies: dict[str, object] = {}
@@ -133,6 +166,24 @@ def _deadline_from(req: dict, default_s: float | None) -> float | None:
     return None
 
 
+def _request_id(req: dict, headers) -> str:
+    """The request's end-to-end correlation id: client-supplied (JSON
+    "request_id", else an X-Request-Id header) or generated. The same id is
+    echoed in the response header/body, names the trace track in
+    /debug/trace, and lands in every ServeRequestRecord.trace_id the request
+    produces."""
+    rid = req.get("request_id")
+    if rid is None:
+        rid = headers.get("X-Request-Id")
+    if rid is None:
+        return uuid.uuid4().hex[:16]
+    if not isinstance(rid, str) or not rid.strip() or len(rid) > 128:
+        raise _BadRequest(
+            "'request_id' must be a non-empty string of at most 128 chars"
+        )
+    return rid.strip()
+
+
 def _gen_config_from(
     req: dict, default_spec_k: int = 0
 ) -> GenerationConfig | None:
@@ -165,10 +216,19 @@ def make_handler(state: ServeState):
         # instead of paying a TCP handshake per request
         protocol_version = "HTTP/1.1"
 
+        # set per-request by the POST handlers once the id is known; _json
+        # then echoes it as X-Request-Id and a request_id body field on every
+        # outcome (200, 429 shed, 500) so clients can always correlate
+        _rid: str | None = None
+
         def _json(self, payload: dict, status: int = 200) -> None:
+            if self._rid is not None:
+                payload = {"request_id": self._rid, **payload}
             body = json.dumps(payload, ensure_ascii=False).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json; charset=utf-8")
+            if self._rid is not None:
+                self.send_header("X-Request-Id", self._rid)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -182,8 +242,28 @@ def make_handler(state: ServeState):
             self.wfile.write(raw)
 
         def do_GET(self) -> None:  # noqa: N802 (stdlib API)
-            path = self.path.partition("?")[0]
-            if path == "/healthz":
+            self._rid = None  # keep-alive: one handler serves many requests
+            path, _, query = self.path.partition("?")
+            if path == "/debug/trace":
+                if state.obs is None:
+                    self._json(
+                        {"error": "tracing disabled (--trace-sample 0)"}, 404
+                    )
+                    return
+                trace = state.obs.chrome_trace()
+                import urllib.parse
+
+                save = urllib.parse.parse_qs(query).get("save", ["0"])[0]
+                if state.trace_dir and save == "1":
+                    p = save_timestamped_trace(trace, state.trace_dir, "serve")
+                    logger.info("wrote trace dump %s", p)
+                body = json.dumps(trace).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/healthz":
                 self._json(
                     {
                         "status": "ok",
@@ -234,6 +314,7 @@ def make_handler(state: ServeState):
             return req
 
         def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+            self._rid = None  # keep-alive: one handler serves many requests
             path = self.path.partition("?")[0]
             if path == "/v1/generate":
                 self._generate()
@@ -269,12 +350,19 @@ def make_handler(state: ServeState):
                 )
                 return
             try:
+                self._rid = _request_id(req, self.headers)
                 max_new_tokens = _number(req, "max_new_tokens", int, integer=True)
                 config = _gen_config_from(req, state.default_spec_k)
                 deadline = _deadline_from(req, state.default_deadline_s)
             except _BadRequest as e:
                 self._json({"error": str(e)}, 400)
                 return
+            # one RequestTrace for the whole HTTP request: multi-prompt
+            # calls put each prompt's spans on its own sub-track
+            trace = (
+                state.obs.start_request(self._rid)
+                if state.obs is not None else None
+            )
             try:
                 completions = state.scheduler.generate_sync(
                     prompts,
@@ -282,14 +370,25 @@ def make_handler(state: ServeState):
                     config=config,
                     deadline=deadline,
                     references=references,
+                    trace=trace,
+                    trace_id=self._rid,
+                    # this handler made the sampling decision (trace may be
+                    # None = sampled out) — the scheduler must not re-draw
+                    trace_owned=True,
                 )
             except RequestShed as e:
+                if state.obs is not None:
+                    state.obs.finish_request(trace, f"shed:{e.reason.value}")
                 self._json({"error": "shed", "reason": e.reason.value}, 429)
                 return
             except Exception as e:  # engine failure: surface, don't crash
+                if state.obs is not None:
+                    state.obs.finish_request(trace, "error")
                 logger.exception("generate failed")
                 self._json({"error": str(e)}, 500)
                 return
+            if state.obs is not None:
+                state.obs.finish_request(trace, "ok")
             self._json(
                 {
                     "completions": [
@@ -315,12 +414,21 @@ def make_handler(state: ServeState):
                 )
                 return
             try:
+                self._rid = _request_id(req, self.headers)
                 max_new_tokens = _number(req, "max_new_tokens", int, integer=True)
                 deadline = _deadline_from(req, state.default_deadline_s)
             except _BadRequest as e:
                 self._json({"error": str(e)}, 400)
                 return
-            qbackend = state.scheduler.backend_view(deadline=deadline)
+            # the trace survives every strategy round: all the request's
+            # fanned-out prompts record onto it through the QueuedBackend
+            trace = (
+                state.obs.start_request(self._rid)
+                if state.obs is not None else None
+            )
+            qbackend = state.scheduler.backend_view(
+                deadline=deadline, trace=trace, trace_id=self._rid
+            )
             t0 = time.monotonic()
             try:
                 # request-level admission: the strategy's rounds fan out as
@@ -338,12 +446,18 @@ def make_handler(state: ServeState):
                 strategy = state.strategy_for(approach, max_new_tokens)
                 result = strategy.summarize(text, backend=qbackend)
             except RequestShed as e:
+                if state.obs is not None:
+                    state.obs.finish_request(trace, f"shed:{e.reason.value}")
                 self._json({"error": "shed", "reason": e.reason.value}, 429)
                 return
             except Exception as e:
+                if state.obs is not None:
+                    state.obs.finish_request(trace, "error")
                 logger.exception("summarize failed")
                 self._json({"error": str(e)}, 500)
                 return
+            if state.obs is not None:
+                state.obs.finish_request(trace, "ok")
             recs = qbackend.records
             self._json(
                 {
@@ -405,6 +519,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="reference-guided speculative decoding: draft up to "
                         "K tokens/step from each request's reference text "
                         "(0 = off; greedy outputs are identical either way)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="fraction of requests recorded into the /debug/trace "
+                        "ring (0 disables tracing entirely; histograms on "
+                        "/metrics stay on regardless)")
+    p.add_argument("--trace-ring", type=int, default=256,
+                   help="how many recent request/batch traces to retain")
+    p.add_argument("--trace-dir", default=None,
+                   help="directory for trace dumps (/debug/trace?save=1, "
+                        "shutdown dump); also arms the device_profile hook "
+                        "(VNSUM_PROFILE_DIR) so the first engine batch "
+                        "captures an XLA device trace alongside")
     args = p.parse_args(argv)
 
     if args.backend == "tpu":
@@ -433,6 +558,9 @@ def main(argv: list[str] | None = None) -> int:
             if args.default_deadline_ms else None
         ),
         default_spec_k=args.spec_k,
+        trace_sample=args.trace_sample,
+        trace_ring=args.trace_ring,
+        trace_dir=args.trace_dir,
     )
     server = make_server(state, args.host, args.port)
     logger.info(
@@ -446,6 +574,11 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         server.server_close()
         state.close()  # drain the queue before exiting
+        if state.obs is not None and args.trace_dir:
+            p = save_timestamped_trace(
+                state.obs.chrome_trace(), args.trace_dir, "serve"
+            )
+            logger.info("wrote shutdown trace dump %s", p)
     return 0
 
 
