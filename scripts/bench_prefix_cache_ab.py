@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Hermetic A/B bench for the radix prefix KV cache (vnsum_tpu.cache).
+
+    JAX_PLATFORMS=cpu python scripts/bench_prefix_cache_ab.py \
+        --out BENCH_cache_r01.json
+
+What it proves (the ISSUE 6 acceptance criteria):
+
+1. **Lossless**: greedy outputs with the cache on are byte-identical to the
+   uncached engine in the cold (insert), warm (resume-prefill), and
+   post-eviction (tight block budget, constant churn) arms;
+2. **Profitable on shared-prefix workloads**: replaying the map fan-out of
+   an already-seen document (the multi-user / retry regime the serving
+   layer exists for) skips >= 30% of prefill tokens on the warm pass, and
+   the instrumented prefill phase — the TTFT driver — gets measurably
+   faster. A supplementary hinted arm shows cache_hint bounding insertion
+   to the shared template header (the cross-DOCUMENT regime): the pool
+   holds only header blocks, and reuse equals the header share.
+
+Hermetic setup: a tiny random-init Llama on CPU. Determinism is all that
+byte-identity needs; no trained fixture required. The workload mirrors what
+the strategies actually emit: map prompts formatted from the Vietnamese
+MAPREDUCE_MAP template (strategies/prompts.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from vnsum_tpu.strategies.prompts import MAPREDUCE_MAP, template_header  # noqa: E402
+
+CONTENT = (
+    "Quốc hội đã thông qua nghị quyết về phát triển kinh tế xã hội với "
+    "nhiều giải pháp trọng tâm cho người dân ở các vùng khó khăn. "
+)
+
+
+def make_workload(n: int, rep: int):
+    """Map-stage-shaped prompts: shared template header + unique content."""
+    hint = template_header(MAPREDUCE_MAP)
+    prompts = [
+        MAPREDUCE_MAP.format(content=CONTENT * rep + f"Đoạn số {i}.")
+        for i in range(n)
+    ]
+    return prompts, [hint] * n
+
+
+def run_arm(backend, prompts, hints, label: str):
+    st = backend.stats
+    base_hit, base_miss = st.cache_hit_tokens, st.cache_miss_tokens
+    t0 = time.time()
+    outs = backend.generate(prompts, cache_hints=hints)  # hints may be None
+    wall = time.time() - t0
+    hit = st.cache_hit_tokens - base_hit
+    miss = st.cache_miss_tokens - base_miss
+    total = hit + miss if (hit + miss) else st.prompt_tokens
+    return {
+        "arm": label,
+        "wall_s": round(wall, 3),
+        "prompt_tokens": total,
+        "cached_prefill_tokens": hit,
+        "prefill_token_reduction": round(hit / total, 4) if total else 0.0,
+        "outputs_preview": [o[:40] for o in outs[:2]],
+    }, outs
+
+
+def prefill_seconds(backend, prompts, hints, reps: int) -> float:
+    """Min instrumented prefill-phase seconds over ``reps`` calls — the
+    device-time TTFT driver, measured with the engine's own result-fetch
+    sync (instrument=True), min-of-reps against CPU scheduling noise."""
+    best = float("inf")
+    for _ in range(reps):
+        before = backend.stats.phase_seconds.get("prefill", 0.0)
+        backend.generate(prompts, cache_hints=hints)
+        best = min(
+            best, backend.stats.phase_seconds.get("prefill", 0.0) - before
+        )
+    return best
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="BENCH_cache_r01.json")
+    p.add_argument("--prompts", type=int, default=16)
+    p.add_argument("--header-rep", type=int, default=4,
+                   help="content repetitions (sets the unique-tail size)")
+    p.add_argument("--max-new", type=int, default=24)
+    p.add_argument("--cache-blocks", type=int, default=64)
+    p.add_argument("--block-tokens", type=int, default=64)
+    p.add_argument("--timing-reps", type=int, default=3)
+    args = p.parse_args()
+
+    from vnsum_tpu.backend.engine import TpuBackend
+    from vnsum_tpu.models import jitted_init, tiny_llama
+    from vnsum_tpu.models.llama import init_params
+
+    cfg = tiny_llama(max_seq_len=2048)
+    params = jitted_init(init_params, cfg, 0)
+    prompts, hints = make_workload(args.prompts, args.header_rep)
+    header_tokens = len(hints[0].encode("utf-8")) + 1
+    prompt_tokens = [len(p.encode("utf-8")) + 1 for p in prompts]
+
+    def backend(**kw):
+        return TpuBackend(
+            model_config=cfg, params=params, batch_size=8,
+            max_new_tokens=args.max_new, seed=0, **kw,
+        )
+
+    # 1) uncached reference
+    base = backend()
+    plain, outs_ref = run_arm(base, prompts, hints, "uncached")
+    run_arm(base, prompts, hints, "uncached_repeat")  # steady-state wall
+
+    # 2) cached, unhinted: cold pass inserts whole prompts (LRU-managed),
+    # warm pass resumes — the multi-user-same-document / retry regime
+    cached = backend(cache_blocks=args.cache_blocks,
+                     cache_block_tokens=args.block_tokens)
+    cold, outs_cold = run_arm(cached, prompts, None, "cached_cold")
+    warm, outs_warm = run_arm(cached, prompts, None, "cached_warm")
+    pool = cached.prefix_cache_stats()
+
+    # 2b) hinted: insertion bounded to the shared template header — the
+    # cross-document regime where only the header recurs. Outputs must
+    # still match; reuse equals the header share of each prompt.
+    hinted = backend(cache_blocks=args.cache_blocks,
+                     cache_block_tokens=args.block_tokens)
+    run_arm(hinted, prompts, hints, "hinted_cold")
+    hint_warm, outs_hint = run_arm(hinted, prompts, hints, "hinted_warm")
+    hinted_pool = hinted.prefix_cache_stats()
+
+    # 3) post-eviction: a pool too small for even one header, churned by an
+    # unrelated workload between passes — outputs must never move
+    tight = backend(cache_blocks=3, cache_block_tokens=args.block_tokens)
+    run_arm(tight, prompts, None, "tight_cold")
+    other = ["Văn bản hoàn toàn khác biệt. " * 30 + f"Tài liệu {i}."
+             for i in range(8)]
+    tight.generate(other)
+    evict, outs_evict = run_arm(tight, prompts, None, "post_eviction")
+    evictions = tight.prefix_cache_stats()["evictions"]
+
+    # 4) TTFT driver: instrumented prefill-phase seconds, warm cache vs none
+    inst_base = backend(instrument=True)
+    t_plain = prefill_seconds(inst_base, prompts, None, args.timing_reps)
+    inst_cached = backend(instrument=True, cache_blocks=args.cache_blocks,
+                          cache_block_tokens=args.block_tokens)
+    inst_cached.generate(prompts)  # warm the pool
+    t_warm = prefill_seconds(inst_cached, prompts, None, args.timing_reps)
+    ttft_speedup = t_plain / t_warm if t_warm else float("inf")
+
+    identical = {
+        "cold": outs_cold == outs_ref,
+        "warm": outs_warm == outs_ref,
+        "hinted_warm": outs_hint == outs_ref,
+        "post_eviction": outs_evict == outs_ref,
+    }
+    reduction = warm["prefill_token_reduction"]
+    checks = {
+        "greedy_outputs_identical_all_arms": all(identical.values()),
+        "prefill_token_reduction_ge_30pct": reduction >= 0.30,
+        "prefill_phase_faster_with_cache": t_warm < t_plain,
+        "eviction_exercised": evictions > 0,
+    }
+    result = {
+        "bench": "prefix_cache_ab",
+        "round": 1,
+        "setup": {
+            "model": "tiny_llama(max_seq_len=2048), random init, greedy",
+            "workload": "MAPREDUCE_MAP header shared by every prompt + "
+                        "unique Vietnamese content tails (map fan-out shape)",
+            "prompts": args.prompts,
+            "header_tokens": header_tokens,
+            "prompt_tokens_mean": round(sum(prompt_tokens) / len(prompt_tokens), 1),
+            "max_new_tokens": args.max_new,
+            "cache": {"blocks": args.cache_blocks,
+                      "block_tokens": args.block_tokens},
+            "platform": "cpu-hermetic (token-count evidence; prefill "
+                        "seconds are instrument=True phase times)",
+        },
+        "arms": {
+            "uncached": plain,
+            "cached_cold": cold,
+            "cached_warm": warm,
+            "hinted_warm": hint_warm,
+            "post_eviction": evict,
+        },
+        "pool_after_warm": pool,
+        "hinted_pool": hinted_pool,
+        "eviction_arm": {"cache_blocks": 3, "evictions": evictions},
+        "ttft_driver": {
+            "prefill_s_uncached": round(t_plain, 4),
+            "prefill_s_warm_cache": round(t_warm, 4),
+            "prefill_speedup": round(ttft_speedup, 2),
+            "reps": args.timing_reps,
+        },
+        "identical": identical,
+        "checks": checks,
+    }
+    Path(args.out).write_text(
+        json.dumps(result, indent=2, ensure_ascii=False) + "\n",
+        encoding="utf-8",
+    )
+    print(json.dumps(checks, indent=2))
+    print(
+        f"warm pass: {warm['cached_prefill_tokens']} of "
+        f"{warm['prompt_tokens']} prefill tokens served from cache "
+        f"({reduction:.0%}); prefill phase {t_plain:.3f}s -> {t_warm:.3f}s "
+        f"({ttft_speedup:.2f}x); {evictions} evictions in the tight arm"
+    )
+    ok = all(checks.values())
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,309 @@
+"""Engine resume-prefill + serving integration for the prefix KV cache.
+
+The acceptance bar (ISSUE 6): greedy outputs must be byte-identical to the
+uncached path in cached, uncached, and post-eviction arms; eviction under a
+tight block budget must never corrupt live rows, including under concurrent
+scheduler traffic; hit accounting must reach ServeRequestRecord and
+/metrics.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from vnsum_tpu.backend.engine import TpuBackend
+from vnsum_tpu.backend.fake import FakeBackend
+from vnsum_tpu.models import jitted_init
+from vnsum_tpu.models.llama import init_params, tiny_llama
+
+HEADER = (
+    "Ban la mot chuyen gia tom tat noi dung. "
+    "Vui long viet mot ban tom tat chi tiet cho van ban sau day. " * 2
+)
+PROMPTS = [HEADER + f"Noi dung rieng biet so {i}: cau chuyen lang que {i}." for i in range(4)]
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_llama(max_seq_len=512)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return jitted_init(init_params, cfg, 0)
+
+
+@pytest.fixture(scope="module")
+def reference_outputs(cfg, params):
+    base = TpuBackend(
+        model_config=cfg, params=params, batch_size=4, max_new_tokens=16
+    )
+    return base.generate(PROMPTS)
+
+
+def make_backend(cfg, params, **kw):
+    kw.setdefault("cache_blocks", 32)
+    kw.setdefault("cache_block_tokens", 64)
+    return TpuBackend(
+        model_config=cfg, params=params, batch_size=4, max_new_tokens=16, **kw
+    )
+
+
+def test_resume_outputs_byte_identical(cfg, params, reference_outputs):
+    b = make_backend(cfg, params)
+    cold = b.generate(PROMPTS)
+    assert cold == reference_outputs          # miss path: plain prefill
+    assert b.take_cache_report() == [0] * 4   # nothing cached yet
+    warm = b.generate(PROMPTS)
+    assert warm == reference_outputs          # hit path: resume prefill
+    report = b.take_cache_report()
+    assert all(r > 0 for r in report)
+    assert b.stats.cache_hit_tokens == sum(report)
+    st = b.prefix_cache_stats()
+    assert st["blocks_used"] > 0
+    # the skip is bounded by the true prefix length
+    for r, p in zip(report, PROMPTS):
+        assert r <= len(p.encode()) + 1
+
+
+def test_resume_identical_in_continuous_mode(cfg, params, reference_outputs):
+    b = make_backend(cfg, params, continuous=True, segment_tokens=8)
+    assert b.generate(PROMPTS) == reference_outputs
+    assert b.generate(PROMPTS) == reference_outputs
+    assert b.stats.cache_hit_tokens > 0
+
+
+def test_post_eviction_outputs_byte_identical(cfg, params, reference_outputs):
+    # 3 blocks of 64 tokens cannot hold even one full header: constant
+    # allocation/eviction churn, outputs must never move
+    b = make_backend(cfg, params, cache_blocks=3)
+    other = ["Van ban hoan toan khac biet " * 12 + f"so {i}" for i in range(4)]
+    assert b.generate(PROMPTS) == reference_outputs
+    b.generate(other)                      # churn the pool
+    assert b.generate(PROMPTS) == reference_outputs
+    assert b.prefix_cache_stats()["evictions"] > 0
+    assert b.prefix_cache_stats()["blocks_used"] <= 3
+
+
+def test_cache_hint_bounds_insertion(cfg, params):
+    b = make_backend(cfg, params, cache_blocks=32, cache_block_tokens=32)
+    hint = HEADER
+    b.generate(PROMPTS, cache_hints=[hint] * len(PROMPTS))
+    hint_tokens = len(hint.encode()) + 1  # + BOS
+    # only hint-covered blocks entered the pool, not the unique tails
+    assert b.prefix_cache_stats()["blocks_used"] <= hint_tokens // 32
+    # and hits still land (prompts share exactly the hinted header)
+    b.generate(PROMPTS, cache_hints=[hint] * len(PROMPTS))
+    assert b.stats.cache_hit_tokens > 0
+
+
+def test_mixed_lengths_group_by_suffix(cfg, params):
+    """Short cold prompts and long warm prompts coexist: ordering by
+    uncovered suffix keeps outputs correct (identical to an uncached run of
+    the same mixed workload)."""
+    mixed = PROMPTS + ["Cau hoi ngan."] * 2
+    base = TpuBackend(
+        model_config=cfg, params=params, batch_size=4, max_new_tokens=16
+    )
+    want = base.generate(mixed)
+    b = make_backend(cfg, params)
+    assert b.generate(mixed) == want
+    assert b.generate(mixed) == want
+
+
+def test_cache_requires_single_chip(cfg, params):
+    class FakeMesh:  # minimal stand-in: engine only checks `is not None`
+        shape = {"data": 1}
+
+    with pytest.raises(ValueError, match="single-chip"):
+        TpuBackend(
+            model_config=cfg, params=params, mesh=FakeMesh(),
+            max_new_tokens=16, cache_blocks=8,
+        )
+
+
+def test_spec_call_bypasses_cache(cfg, params):
+    from vnsum_tpu.core.config import GenerationConfig
+
+    b = make_backend(cfg, params)
+    b.generate(PROMPTS)
+    outs = b.generate(
+        PROMPTS, config=GenerationConfig(spec_k=4), references=PROMPTS
+    )
+    assert b.take_cache_report() == []  # spec path: no cache attribution
+    assert len(outs) == len(PROMPTS)
+
+
+# -- FakeBackend mirror ------------------------------------------------------
+
+
+def test_fake_backend_cache_contract():
+    fb = FakeBackend(prefix_cache_blocks=16, cache_block_tokens=4)
+    prompts = ["chung toi cung mot tieu de dai " * 3 + f"duy nhat {i}" for i in range(3)]
+    fb.generate(prompts)
+    assert fb.take_cache_report() == [0, 0, 0]  # first pass: all misses...
+    # ...except identical re-submissions, which now hit
+    fb.generate(prompts)
+    report = fb.take_cache_report()
+    assert all(r > 0 for r in report)
+    assert fb.cached_prefix_tokens(prompts[0]) > 0
+    st = fb.prefix_cache_stats()
+    assert st["blocks_used"] > 0 and st["blocks_total"] == 16
+
+
+def test_fake_backend_honors_cache_hint():
+    fb = FakeBackend(prefix_cache_blocks=64, cache_block_tokens=2)
+    hint = "mot hai ba bon"  # 4 words -> 2 blocks
+    prompts = [hint + f" phan duoi khac nhau hoan toan so {i} a b c d" for i in range(2)]
+    fb.generate(prompts, cache_hints=[hint, hint])
+    assert fb.cache_hints_seen == [hint, hint]
+    assert fb.prefix_cache_stats()["blocks_used"] == 2  # hint-bounded
+    fb.generate(prompts, cache_hints=[hint, hint])
+    assert fb.take_cache_report() == [4, 4]
+
+
+def test_fake_backend_cache_off_by_default():
+    fb = FakeBackend()
+    fb.generate(["xin chao"])
+    assert fb.take_cache_report() == []
+    assert fb.prefix_cache_stats() is None
+    assert fb.cached_prefix_tokens("xin chao") == 0
+
+
+# -- serving integration -----------------------------------------------------
+
+
+def test_queue_bills_only_uncached_tokens():
+    from vnsum_tpu.serve.queue import RequestQueue, RequestShed, ServeRequest
+
+    q = RequestQueue(max_depth=8, max_queued_tokens=10)
+    q.submit(ServeRequest(prompt="a", est_tokens=6))
+    # 9 estimated tokens but 5 cached: 4 billable -> fits the budget
+    q.submit(ServeRequest(prompt="b", est_tokens=9, cached_tokens=5))
+    assert q.queued_tokens == 10
+    # an uncached twin of the same size sheds
+    with pytest.raises(RequestShed):
+        q.submit(ServeRequest(prompt="c", est_tokens=9))
+
+
+def test_scheduler_attributes_cache_hits_to_records_and_metrics():
+    from vnsum_tpu.serve.scheduler import MicroBatchScheduler
+
+    fb = FakeBackend(prefix_cache_blocks=64, cache_block_tokens=2)
+    sched = MicroBatchScheduler(
+        fb, max_batch=4, max_wait_s=0.005, max_queued_tokens=10_000
+    )
+    try:
+        prompt = "tieu de chung cua tat ca cac yeu cau " * 3 + "duoi khac"
+        c1 = sched.submit(prompt).result(timeout=5)
+        assert c1.record.cached_prompt_tokens == 0
+        # warm: the same prompt now hits; the submit-time probe discounts it
+        c2 = sched.submit(prompt).result(timeout=5)
+        assert c2.record.cached_prompt_tokens > 0
+        assert 0 < c2.record.cache_hit_rate <= 1.0
+        snap = sched.metrics.snapshot()
+        assert snap.cache_hit_tokens == c2.record.cached_prompt_tokens
+        text = sched.metrics.render_prometheus(
+            cache_stats=fb.prefix_cache_stats()
+        )
+        assert "vnsum_serve_cache_hit_tokens_total" in text
+        assert "vnsum_serve_cache_blocks_used" in text
+        assert "vnsum_serve_cache_evictions_total" in text
+    finally:
+        sched.close()
+
+
+def test_eviction_never_corrupts_under_concurrent_traffic():
+    """Acceptance: a 6-block pool under 4 threads x 3 distinct shared-prefix
+    workloads churns eviction constantly; every completion must still equal
+    the deterministic FakeBackend output for its prompt."""
+    from vnsum_tpu.serve.scheduler import MicroBatchScheduler
+
+    fb = FakeBackend(prefix_cache_blocks=6, cache_block_tokens=2)
+    oracle = FakeBackend()  # no cache: the ground-truth transformer
+    sched = MicroBatchScheduler(fb, max_batch=4, max_wait_s=0.002)
+    headers = [f"tieu de so {h} lap lai nhieu lan cho nhom nay " for h in range(3)]
+    errors = []
+
+    def client(tid):
+        try:
+            for i in range(12):
+                h = headers[(tid + i) % len(headers)]
+                prompt = h * 2 + f"phan than bai rieng {tid} {i} con lai"
+                got = sched.submit(prompt, cache_hint=h * 2).result(timeout=10)
+                want = oracle.generate([prompt])[0]
+                if got.text != want:
+                    errors.append((prompt, got.text, want))
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sched.close()
+    assert not errors
+    st = fb.prefix_cache_stats()
+    assert st["evictions"] > 0          # the budget really was tight
+    assert st["blocks_used"] <= 6
+
+
+def test_http_cache_hint_and_metrics_end_to_end():
+    """POST /v1/generate with a cache_hint; the second identical request's
+    record reports cached tokens and /metrics carries the cache series."""
+    import json
+    import urllib.request
+
+    from vnsum_tpu.serve.server import ServeState, make_server
+
+    state = ServeState(
+        FakeBackend(prefix_cache_blocks=64, cache_block_tokens=2),
+        max_batch=4, max_wait_s=0.005,
+    )
+    server = make_server(state, "127.0.0.1", 0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        hint = "tieu de dung chung giua cac yeu cau"
+        body = json.dumps({
+            "prompt": hint + " phan noi dung rieng cua yeu cau nay",
+            "cache_hint": hint,
+        }).encode()
+
+        def post():
+            req = urllib.request.Request(
+                base + "/v1/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        first = post()["completions"][0]["record"]
+        assert first["cached_prompt_tokens"] == 0
+        second = post()["completions"][0]["record"]
+        assert second["cached_prompt_tokens"] > 0
+        assert second["cache_hit_rate"] > 0
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+        assert "vnsum_serve_cache_hit_tokens_total" in metrics
+        assert "vnsum_serve_cache_blocks_total 64" in metrics
+    finally:
+        server.shutdown()
+        server.server_close()
+        state.close()
+
+
+def test_take_batch_clusters_by_cache_hint():
+    from vnsum_tpu.serve.queue import RequestQueue, ServeRequest
+
+    q = RequestQueue(max_depth=16)
+    for hint in ("A", "B", "A", "B", "A"):
+        q.submit(ServeRequest(prompt=f"p{hint}", cache_hint=hint))
+    batch = q.take_batch(max_batch=3, max_wait_s=0.0)
+    assert [r.cache_hint for r in batch] == ["A", "A", "A"]
+    batch2 = q.take_batch(max_batch=3, max_wait_s=0.0)
+    assert [r.cache_hint for r in batch2] == ["B", "B"]

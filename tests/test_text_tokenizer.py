@@ -1,4 +1,77 @@
+import pytest
+
 from vnsum_tpu.text import ByteTokenizer, get_tokenizer, whitespace_token_count
+
+
+def _shipped_templates():
+    """Every prompt template the strategies format, with its header — the
+    cache_hint surface prefix caching (vnsum_tpu.cache) depends on."""
+    from vnsum_tpu.strategies import prompts as P
+
+    return {
+        name: (tpl, P.template_header(tpl))
+        for name, tpl in vars(P).items()
+        if name.isupper() and isinstance(tpl, str) and "{" in tpl
+    }
+
+
+def test_template_headers_are_string_prefixes():
+    """The cache_hint each strategy passes must literally prefix the prompt
+    it formats — template_header guarantees it by slicing before the first
+    placeholder, but the templates themselves must not open with one."""
+    templates = _shipped_templates()
+    assert len(templates) >= 10  # all reference prompts present
+    content = "Nội dung văn bản tiếng Việt có dấu thanh."
+    fills = {
+        "content": content, "docs": content, "summary": content,
+        "original_chunks": content, "current_summary": content,
+        "critique": content, "reference_content": content,
+        "context": content, "existing_answer": content, "text": content,
+    }
+    for name, (tpl, head) in templates.items():
+        assert tpl.format(**{
+            k: v for k, v in fills.items() if "{" + k + "}" in tpl
+        }).startswith(head), name
+
+
+@pytest.mark.parametrize("tok_kind", ["byte", "bpe"])
+def test_template_tokenization_is_prefix_stable(tok_kind):
+    """tokenize(header + content) must START WITH tokenize(header) for every
+    shipped template — prefix caching is unsound otherwise (a cached header
+    block would hold KV for token ids the real prompt doesn't contain).
+    Checked for the default byte tokenizer (exact by construction: UTF-8
+    bytes never merge) AND a trained HF BPE (merges could cross the
+    boundary; the headers end at newline/colon boundaries precisely so they
+    don't)."""
+    templates = _shipped_templates()
+    contents = [
+        "Quốc hội đã thông qua nghị quyết về phát triển kinh tế xã hội.",
+        "a",  # single ASCII char: the hardest boundary for BPE merges
+        "\nxuống dòng trước nội dung",
+    ]
+    if tok_kind == "byte":
+        tok = ByteTokenizer()
+    else:
+        pytest.importorskip("tokenizers")
+        import tempfile
+
+        from vnsum_tpu.models.fixtures import train_bpe_tokenizer
+        from vnsum_tpu.text.tokenizer import HFTokenizer
+
+        corpus = ["Bạn là một chuyên gia tóm tắt nội dung tiếng Việt."] * 4 + [
+            t for t, _ in templates.values()
+        ]
+        hf = train_bpe_tokenizer(corpus, vocab_size=512)
+        d = tempfile.mkdtemp()
+        hf.save_pretrained(d)
+        tok = HFTokenizer(d)
+    for name, (_, head) in templates.items():
+        if not head:
+            continue
+        head_ids = tok.encode(head, add_bos=True)
+        for content in contents:
+            full_ids = tok.encode(head + content, add_bos=True)
+            assert full_ids[: len(head_ids)] == head_ids, (name, content)
 
 
 def test_byte_roundtrip_vietnamese():

@@ -17,6 +17,16 @@ their hot paths unconditionally. Recognized phase names: "tokenize",
 "decode_seg", "spec_step", "dispatch" (fused one-shot program),
 "detokenize". TpuBackend and FakeBackend implement it; HTTP parity backends
 (ollama/hf) simply emit nothing.
+
+Optional prefix-cache contract (vnsum_tpu.cache): backends with a prefix KV
+cache additionally expose ``cached_prefix_tokens(text, cache_hint=None)``
+(thread-safe read-only probe — the serving queue bills only uncached tokens
+against its admission budget), ``take_cache_report()`` (per-prompt cached
+token counts of the last generate, cleared on read — scheduler attribution
+into ServeRequestRecord), and ``prefix_cache_stats()`` (pool gauges for
+/metrics). The scheduler discovers all three via getattr, so plain backends
+need none of them. TpuBackend implements the real thing; FakeBackend mirrors
+it synthetically (a real radix index over whitespace words, no device pool).
 """
 from __future__ import annotations
 
@@ -36,15 +46,23 @@ class Backend(Protocol):
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
         references: list[str | None] | None = None,
+        cache_hints: list[str | None] | None = None,
     ) -> list[str]:
         """Generate one completion per prompt, order-preserving.
 
         ``references`` optionally carries one source text per prompt (None
         entries allowed) for reference-guided speculative decoding
         (vnsum_tpu.spec): strategies pass the chunk being summarized, and a
-        backend with ``config.spec_k > 0`` drafts from it. Backends without
-        speculation accept and ignore it — it is advisory metadata, never a
-        semantic input."""
+        backend with ``config.spec_k > 0`` drafts from it.
+
+        ``cache_hints`` optionally carries one string per prompt naming the
+        prompt PREFIX the caller expects to recur (template headers,
+        carried-forward summaries) for the radix prefix KV cache
+        (vnsum_tpu.cache): a backend with the cache enabled bounds its block
+        insertion to the hinted prefix so unique content tails don't churn
+        the pool. Both are advisory metadata, never semantic inputs —
+        backends without the feature accept and ignore them, and greedy
+        outputs are identical either way."""
         ...
 
     def count_tokens(self, text: str) -> int:

@@ -89,6 +89,11 @@ class EngineStats:
     spec_verify_steps: int = 0
     spec_draft_tokens: int = 0
     spec_accepted_tokens: int = 0
+    # prefix KV cache (vnsum_tpu.cache): prompt tokens whose prefill was
+    # skipped by resuming from cached prefix blocks, vs tokens prefilled
+    # from scratch — hit/(hit+miss) is the prefill-token reduction
+    cache_hit_tokens: int = 0
+    cache_miss_tokens: int = 0
     compactions: int = 0
     compacted_batch_sizes: list = field(default_factory=list)
     by_bucket: dict = field(default_factory=dict)
@@ -135,6 +140,8 @@ class TpuBackend:
         instrument: bool = False,
         prefill_chunk_tokens: int = 0,
         spec_max_ref_tokens: int = 4096,
+        cache_blocks: int = 0,
+        cache_block_tokens: int = 64,
     ) -> None:
         from ..core.jax_cache import enable_compilation_cache
 
@@ -252,6 +259,38 @@ class TpuBackend:
         self.spec_max_ref_tokens = int(spec_max_ref_tokens)
         self._spec_report: list = []
         self._warned_spec_fallback = False
+        # radix prefix KV cache (vnsum_tpu.cache): cache_blocks > 0 retains
+        # prefix KV blocks on device after prefill and later batches resume
+        # prefill from the matched prefix, computing only the suffix.
+        # Single-chip for now, like speculative decoding's verify kernel.
+        self.prefix_cache = None
+        self._cache_report: list = []
+        self._hint_ids_cache: dict[str, list[int]] = {}
+        if cache_blocks:
+            if mesh is not None:
+                raise ValueError(
+                    "the prefix KV cache is single-chip for now; "
+                    "cache_blocks requires mesh=None"
+                )
+            if not 1 <= cache_block_tokens <= 128:
+                # the resume boundary K is 128-aligned, and the padded-gather
+                # safety argument (scratch writes land inside the recomputed
+                # [K, S) span) needs blocks no wider than that alignment
+                raise ValueError("cache_block_tokens must be in [1, 128]")
+            from ..cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                cache_blocks, cache_block_tokens,
+                n_layers=self.cfg.n_layers,
+                n_kv_heads=self.cfg.n_kv_heads,
+                head_dim=self.cfg.head_dim, dtype=self.cfg.dtype,
+                quantized=self.quantize_kv,
+            )
+            logger.info(
+                "prefix KV cache: %d blocks x %d tokens (%.1f MB HBM)",
+                cache_blocks, cache_block_tokens,
+                self.prefix_cache.store.hbm_bytes / 1e6,
+            )
 
         if params is None:
             t0 = time.time()
@@ -295,10 +334,11 @@ class TpuBackend:
 
         return eos, vocab_limit, restrict
 
-    def _make_parts(self, B: int, S: int, max_new: int, gen: GenerationConfig):
+    def _make_parts(self, B: int, S: int, max_new: int, gen: GenerationConfig,
+                    resume_from: int = 0):
         """The two traceable halves every generation program is composed of:
 
-        prefill_part(params, tokens, pad_lens, seed)
+        prefill_part(params, tokens, pad_lens, seed[, cache])
             -> (first_token, cache, done0)
         decode_part(params, t0, cur, cache, done, uids, out, pad_lens,
                     t_end, seed)
@@ -313,7 +353,13 @@ class TpuBackend:
         The one-shot program is prefill + one decode to t_end=max_new in a
         single jit; the continuous scheduler jits them separately and runs
         decode in segments — ONE body definition serves both, so the paths
-        cannot drift."""
+        cannot drift.
+
+        ``resume_from=K`` (prefix KV cache, vnsum_tpu.cache) builds the
+        resume-prefill variant: prefill_part takes a cache pre-seeded with
+        gathered prefix blocks and runs the forward only over cache slots
+        [K, S) — positions and masks are unchanged, so the math over the
+        computed span is identical to full prefill's."""
         cfg = self.cfg
         C = S + max_new
         eos, vocab_limit, restrict = self._sampling_setup(gen)
@@ -329,9 +375,10 @@ class TpuBackend:
         # at a chunk's worth, which is what lets B=16 decode fit at S=8192
         # (measured 1.36x decode vs 2x B=8 dispatches,
         # artifacts/b16_chunked_prefill.json); see _prefill_forward
-        def prefill_part(params, tokens, pad_lens, seed):
+        def prefill_part(params, tokens, pad_lens, seed, cache=None):
             logits, cache = self._prefill_forward(
-                params, tokens, pad_lens, B, S, C, use_flash, layer_window
+                params, tokens, pad_lens, B, S, C, use_flash, layer_window,
+                cache=cache, start=resume_from,
             )
             base = jax.random.key(seed)
             uids0 = jnp.arange(B, dtype=jnp.int32)
@@ -412,19 +459,35 @@ class TpuBackend:
 
         return prefill_part, decode_part
 
-    def _make_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig):
+    def _make_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig,
+                 resume_from: int = 0):
         pad_id = self.tok.pad_id
-        prefill_part, decode_part = self._make_parts(B, S, max_new, gen)
+        prefill_part, decode_part = self._make_parts(
+            B, S, max_new, gen, resume_from
+        )
+        # with the prefix cache on, the one-shot program also returns its
+        # final cache: decode never touches slots < S, so the prompt's
+        # prefix KV survives for post-call insertion into the block pool
+        return_cache = self.prefix_cache is not None
 
-        def generate(params, tokens, pad_lens, seed):
-            first, cache, done0 = prefill_part(params, tokens, pad_lens, seed)
+        def run(params, tokens, pad_lens, seed, cache):
+            first, cache, done0 = prefill_part(
+                params, tokens, pad_lens, seed, cache
+            )
             out0 = jnp.full((B, max_new), pad_id, dtype=jnp.int32)
             uids = jnp.arange(B, dtype=jnp.int32)
-            *_, out = decode_part(
+            _, _, cache, _, out = decode_part(
                 params, jnp.int32(0), first, cache, done0, uids, out0,
                 pad_lens, max_new, seed,
             )
-            return out  # [B, max_new]
+            return (out, cache) if return_cache else out  # out: [B, max_new]
+
+        if resume_from:
+            # the seeded cache is consumed — donate its buffer
+            return jax.jit(run, donate_argnums=(4,))
+
+        def generate(params, tokens, pad_lens, seed):
+            return run(params, tokens, pad_lens, seed, None)
 
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -457,14 +520,18 @@ class TpuBackend:
             None,
         )
 
-    def _get_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig):
+    def _get_fn(self, B: int, S: int, max_new: int, gen: GenerationConfig,
+                resume_from: int = 0):
         # seed is a runtime argument to the compiled program, not a trace
         # constant — exclude it from the cache key so seed sweeps reuse code
-        key = (B, S, max_new, gen.with_(seed=0))
+        key = (B, S, max_new, gen.with_(seed=0), resume_from)
         if key not in self._fns:
             t0 = time.time()
-            self._fns[key] = self._make_fn(B, S, max_new, gen)
-            logger.info("built generate fn for bucket B=%d S=%d new=%d", B, S, max_new)
+            self._fns[key] = self._make_fn(B, S, max_new, gen, resume_from)
+            logger.info(
+                "built generate fn for bucket B=%d S=%d new=%d resume=%d",
+                B, S, max_new, resume_from,
+            )
             self.stats.compile_seconds += time.time() - t0
         return self._fns[key]
 
@@ -538,32 +605,43 @@ class TpuBackend:
         return stacked_fn
 
     def _prefill_forward(self, params, tokens, pad_lens, B, S, C,
-                         use_flash, layer_window):
-        """Whole- or chunked-prompt prefill into a fresh cache; returns
-        (last-position logits, cache). ONE copy shared by prefill_part
-        (_make_parts) and the choice scorer (_make_choice_fn), so the two
-        paths cannot drift AND the chunked path's memory headroom applies
-        to both. Called inside traced functions — pad_lens is a tracer;
-        chunk boundaries are trace-static."""
+                         use_flash, layer_window, cache=None, start=0):
+        """Whole- or chunked-prompt prefill; returns (last-position logits,
+        cache). ONE copy shared by prefill_part (_make_parts) and the choice
+        scorer (_make_choice_fn), so the two paths cannot drift AND the
+        chunked path's memory headroom applies to both. Called inside traced
+        functions — pad_lens is a tracer; chunk boundaries are trace-static.
+
+        ``start`` > 0 is the prefix-cache resume boundary K: ``cache``
+        arrives pre-seeded with gathered prefix KV for slots < K and the
+        forward runs only over [K, S) — the same shape as chunked prefill's
+        later chunks (positions/masks are sliced, q_offset places the
+        queries), so resume and chunked share all their machinery."""
         cfg = self.cfg
-        cache = self._init_prefill_cache(B, C)
+        if cache is None:
+            cache = self._init_prefill_cache(B, C)
         positions = prefill_positions(pad_lens, S)
         mask = prefill_attention_mask(pad_lens, S, C)
         CL = self.prefill_chunk_tokens
-        n_chunks = -(-S // CL) if CL and S > CL else 1
+        span = S - start
+        n_chunks = -(-span // CL) if CL and span > CL else 1
         if n_chunks == 1:
+            if start:
+                tokens = tokens[:, start:]
+                positions = positions[:, start:]
+                mask = mask[:, start:, :]
             return forward(
-                params, cfg, tokens, positions, cache, 0, mask,
+                params, cfg, tokens, positions, cache, start, mask,
                 last_only=True,
                 stacked_attention_fn=self._prefill_stacked(
-                    use_flash, pad_lens, layer_window
+                    use_flash, pad_lens, layer_window, q_offset=start
                 ),
             )
         # chunked: transient activations scale with the CHUNK length, not
         # the full S — the kernel's q_offset places chunk c's queries at
         # cache slots [lo, hi) (see prefill_part's rationale comment)
         for c in range(n_chunks):
-            lo, hi = c * CL, min(S, (c + 1) * CL)
+            lo, hi = start + c * CL, min(S, start + (c + 1) * CL)
             logits, cache = forward(
                 params, cfg, tokens[:, lo:hi], positions[:, lo:hi],
                 cache, lo, mask[:, lo:hi, :],
@@ -695,8 +773,12 @@ class TpuBackend:
             use_flash_decode = supports_decode(C, self.cfg.head_dim)
         return use_flash, use_flash_decode
 
-    def _make_prefill_fn(self, B: int, S: int, max_new: int, gen):
-        prefill_part, _ = self._make_parts(B, S, max_new, gen)
+    def _make_prefill_fn(self, B: int, S: int, max_new: int, gen,
+                         resume_from: int = 0):
+        prefill_part, _ = self._make_parts(B, S, max_new, gen, resume_from)
+
+        if resume_from:
+            return jax.jit(prefill_part, donate_argnums=(4,))
 
         def prefill(params, tokens, pad_lens, seed):
             return prefill_part(params, tokens, pad_lens, seed)
@@ -735,15 +817,16 @@ class TpuBackend:
         # the buffers can't be reused (donating only triggers warnings)
         return jax.jit(compact)
 
-    def _get_seg_fn(self, kind: str, B: int, S: int, max_new: int, gen):
-        key = (kind, B, S, max_new, gen.with_(seed=0))
+    def _get_seg_fn(self, kind: str, B: int, S: int, max_new: int, gen,
+                    resume_from: int = 0):
+        key = (kind, B, S, max_new, gen.with_(seed=0), resume_from)
         if key not in self._seg_fns:
             t0 = time.time()
-            builder = {
-                "prefill": self._make_prefill_fn,
-                "segment": self._make_segment_fn,
-            }[kind]
-            self._seg_fns[key] = builder(B, S, max_new, gen)
+            if kind == "prefill":
+                fn = self._make_prefill_fn(B, S, max_new, gen, resume_from)
+            else:
+                fn = self._make_segment_fn(B, S, max_new, gen)
+            self._seg_fns[key] = fn
             logger.info("built %s fn for bucket B=%d S=%d", kind, B, S)
             self.stats.compile_seconds += time.time() - t0
         return self._seg_fns[key]
@@ -754,7 +837,8 @@ class TpuBackend:
         return s
 
     def _run_group_continuous(
-        self, group, encoded, max_new: int, gen, results, seed: int
+        self, group, encoded, max_new: int, gen, results, seed: int,
+        packed=None, resume=None, insert_cb=None,
     ) -> None:
         """Generate one prompt group with segmented decode + tail compaction.
 
@@ -763,8 +847,17 @@ class TpuBackend:
         the survivors gathered into it. Output is identical to the one-shot
         path for greedy AND sampled decode — greedy depends only on the
         row's own cache, and sampled streams are keyed by (seed, row uid,
-        step), not batch position."""
-        tokens, pads, B, S = self._pack_group(group, encoded, max_new)
+        step), not batch position.
+
+        ``resume`` = (K, seeded_cache) runs the resume-prefill variant over
+        [K, S) against prefix-cache blocks already gathered into the cache;
+        ``insert_cb(cache)`` fires right after prefill (the copies dispatch
+        before the first segment's donation can retire the buffer) so new
+        prefix blocks enter the pool."""
+        tokens, pads, B, S = (
+            packed if packed is not None
+            else self._pack_group(group, encoded, max_new)
+        )
         rows: list[int | None] = [None] * B
         for row, i in enumerate(group):
             rows[row] = i
@@ -774,11 +867,17 @@ class TpuBackend:
         # it the answer cannot change, and per-segment emit bookkeeping
         # (timestamps, mask reductions, kwargs) is skipped entirely when off
         tracing = current_collector() is not None
-        prefill = self._get_seg_fn("prefill", B, S, max_new, gen)
+        K = resume[0] if resume else 0
+        prefill = self._get_seg_fn("prefill", B, S, max_new, gen, K)
         t_pre = time.time()
         t_pre_m = time.monotonic()
         with annotate(f"prefill[B={B},S={S}]"):
-            cur, cache, done = prefill(self.params, tokens, pads, seed)
+            if resume:
+                cur, cache, done = prefill(
+                    self.params, tokens, pads, seed, resume[1]
+                )
+            else:
+                cur, cache, done = prefill(self.params, tokens, pads, seed)
             if self.instrument:
                 # fetch forces the dispatch to completion: [B] bools, the
                 # cheapest output — prefill device time is now bounded
@@ -794,6 +893,11 @@ class TpuBackend:
             self.stats.add_phase("prefill", prefill_s)
         self.stats.batches += 1
         self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
+        if insert_cb is not None:
+            # prefix-cache insertion must read the cache BEFORE the first
+            # segment dispatch donates its buffer; the copies dispatch here,
+            # in stream order ahead of the donation
+            insert_cb(cache)
 
         out = jnp.full((B, max_new), self.tok.pad_id, dtype=jnp.int32)
         pad_dev = jnp.asarray(pads)
@@ -1105,6 +1209,148 @@ class TpuBackend:
                 verify_steps=int(steps_live[row]),
             )
 
+    # -- prefix KV cache (vnsum_tpu.cache) -------------------------------
+
+    def _prepare_resume(self, group, encoded, matches, pad_lens, B, S,
+                        max_new: int, tracing: bool):
+        """Compute the trace-static skip boundary K for one packed group and
+        gather the matched prefix blocks into a seeded cache.
+
+        Slot arithmetic (left-padded rows; pad_r = S - len_r):
+
+        - K = 128-aligned floor of (S - longest uncovered suffix): for every
+          row, slots [pad_r, K) are covered by matched blocks, so ONE static
+          boundary serves the whole batch; rows whose prompt starts at or
+          after K (pad_r >= K) need no blocks at all.
+        - row r gathers ceil((K - pad_r)/BLK) blocks at slots pad_r + i*BLK;
+          ragged rows pad with the scratch block, whose writes land at slots
+          >= K — inside the span the suffix prefill (slots [K, S)) or decode
+          (slots >= S, each written before it is ever attended) overwrites —
+          so padding can never corrupt a live row.
+
+        Returns (K, seeded_cache, skipped_per_row) or None when the group
+        has no usable 128-aligned coverage."""
+        pc = self.prefix_cache
+        BLK = pc.block_tokens
+        max_suffix = max(len(encoded[i]) - matches[i].tokens for i in group)
+        # the scratch-padding safety argument needs clamped writes
+        # (dynamic_update_slice clamps starts to C - BLK) to still land at
+        # slots >= K. S is usually a 128-multiple bucket, making C - BLK >=
+        # K automatic — but the bucket FALLBACK (prompt longer than the last
+        # bucket) is max_input, which need not be aligned, so cap K
+        # explicitly rather than assume it.
+        # K is quantized to a coarse grid (max(128, S/8) steps): each
+        # distinct K compiles its own resume program per (B, S, max_new)
+        # bucket, so a fine grid would let a warm server accrete up to S/128
+        # executables per bucket — 8 variants bounds compile churn while
+        # giving up at most one step of skip
+        step = max(128, S // 8 // 128 * 128)
+        K = min(S - max_suffix, S + max_new - BLK) // step * step
+        if K < 128:
+            return None
+        ids_rows: list[list[int]] = []
+        nb_max = 0
+        for row, i in enumerate(group):
+            pad = int(pad_lens[row])
+            need = K - pad
+            n = -(-need // BLK) if need > 0 else 0
+            blocks = matches[i].blocks[:n]
+            ids_rows.append(blocks)
+            nb_max = max(nb_max, len(blocks))
+        if nb_max == 0:
+            return None
+        t0 = time.time()
+        t0_m = time.monotonic() if tracing else 0.0
+        ids = np.full((B, nb_max), pc.store.scratch_id, dtype=np.int32)
+        for row, blocks in enumerate(ids_rows):
+            ids[row, : len(blocks)] = blocks
+        cache = self._init_prefill_cache(B, S + max_new)
+        cache = pc.gather(cache, ids, pad_lens)
+        skipped = [
+            max(K - int(pad_lens[row]), 0) for row in range(len(group))
+        ]
+        if tracing:
+            emit("cache_gather", t0_m, time.time() - t0, B=B, K=K,
+                 blocks=int((ids != pc.store.scratch_id).sum()),
+                 hit_tokens=sum(skipped))
+        return K, cache, skipped
+
+    def _cache_insert(self, cache, group, encoded, matches, hints, pad_lens,
+                      tracing: bool) -> int:
+        """Index the freshly prefilled prompts and copy their new prefix
+        blocks into the pool. A cache_hint bounds the insertion to the
+        hint-covered prefix (template headers, carried-forward summaries) so
+        unique content tails don't churn the pool; without one the whole
+        prompt (minus its last token) is insertable and LRU manages it."""
+        pc = self.prefix_cache
+        BLK = pc.block_tokens
+        t0 = time.time()
+        t0_m = time.monotonic() if tracing else 0.0
+        evict0 = pc.index.stats.evictions
+        new_blocks = 0
+        for row, i in enumerate(group):
+            ids = encoded[i]
+            target = len(ids) - 1
+            hint = hints[i] if hints else None
+            if hint:
+                target = min(self._hint_prefix_len(hint, ids), target)
+            upto = target // BLK * BLK
+            if upto > matches[i].tokens:
+                new_blocks += pc.insert(
+                    cache, row, int(pad_lens[row]), ids, upto
+                )
+        if tracing and (new_blocks or pc.index.stats.evictions != evict0):
+            emit("cache_insert", t0_m, time.time() - t0, blocks=new_blocks,
+                 evictions=pc.index.stats.evictions - evict0)
+        return new_blocks
+
+    def _hint_prefix_len(self, hint: str, ids: list[int]) -> int:
+        """Token-aligned hint boundary: the longest common prefix of the
+        hint's own encoding and the prompt's. Exact when tokenization is
+        prefix-stable (tests/test_text_tokenizer.py pins the shipped
+        templates); safely shorter when a merge crosses the boundary."""
+        hint_ids = self._hint_ids_cache.get(hint)
+        if hint_ids is None:
+            if len(self._hint_ids_cache) >= 256:
+                self._hint_ids_cache.clear()
+            hint_ids = self.tok.encode(hint, add_bos=True)
+            self._hint_ids_cache[hint] = hint_ids
+        n = min(len(hint_ids), len(ids))
+        k = 0
+        while k < n and hint_ids[k] == ids[k]:
+            k += 1
+        return k
+
+    def cached_prefix_tokens(self, text: str, cache_hint: str | None = None) -> int:
+        """Read-only probe: how many prompt tokens the prefix cache would
+        serve right now. Thread-safe (the radix probe path), used by the
+        serving queue to bill only uncached tokens against the admission
+        token budget. An estimate — the usable skip also depends on batch
+        composition (the 128-aligned K)."""
+        if self.prefix_cache is None:
+            return 0
+        ids = self.tok.encode(text, add_bos=True)
+        # same truncation generate() applies for the default decode budget,
+        # so the admission discount can never exceed what a dispatch could
+        # actually reuse
+        max_input = self.cfg.max_seq_len - self.max_new_tokens
+        if len(ids) > max_input:
+            ids = ids[:max_input]
+        return self.prefix_cache.probe(ids, max_tokens=len(ids) - 1)
+
+    def prefix_cache_stats(self) -> dict | None:
+        """Pool/index counters for /metrics gauges (None = cache off)."""
+        if self.prefix_cache is None:
+            return None
+        return self.prefix_cache.stats_dict()
+
+    def take_cache_report(self) -> list[int]:
+        """Per-prompt prefill tokens served from the prefix cache on the
+        LAST generate call (empty when the cache was off), cleared on read —
+        the same attribution hook shape as take_spec_report."""
+        report, self._cache_report = self._cache_report, []
+        return report
+
     def take_spec_report(self):
         """Per-prompt SpecRecords of the LAST generate call, aligned with
         its prompt order (empty when speculation was off), cleared on read.
@@ -1144,6 +1390,7 @@ class TpuBackend:
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
         references: list[str | None] | None = None,
+        cache_hints: list[str | None] | None = None,
     ) -> list[str]:
         gen = config or self.gen_cfg
         max_new = resolve_max_new(max_new_tokens, gen, self.max_new_tokens)
@@ -1156,6 +1403,11 @@ class TpuBackend:
         if references is not None and len(references) != len(prompts):
             raise ValueError(
                 f"references must align with prompts: got {len(references)} "
+                f"for {len(prompts)}"
+            )
+        if cache_hints is not None and len(cache_hints) != len(prompts):
+            raise ValueError(
+                f"cache_hints must align with prompts: got {len(cache_hints)} "
                 f"for {len(prompts)}"
             )
 
@@ -1184,6 +1436,10 @@ class TpuBackend:
 
         self.stats.calls += 1
         self.stats.prompts += len(prompts)
+        # cleared up front: a call that errors mid-loop must not leave a
+        # previous call's per-prompt cache attribution behind for the
+        # scheduler's take_cache_report to misread
+        self._cache_report = []
 
         # telemetry gate, resolved once per generate() call (see the obs
         # contract in backend/base.py): untraced runs skip every emit's
@@ -1205,8 +1461,35 @@ class TpuBackend:
             emit("tokenize", t_enc_m, time.time() - t_enc,
                  prompts=len(prompts))
 
-        # group indices by bucketed length, then emit fixed-shape batches
-        order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
+        # prefix KV cache (vnsum_tpu.cache): match every prompt against the
+        # radix index (pinning the matched blocks against eviction for the
+        # duration of the call) and order rows by UNCOVERED suffix length —
+        # a group's usable skip K is S minus its longest suffix, so one cold
+        # row mixed into a warm group would zero everyone's reuse. Spec
+        # calls skip the cache: the verify path's per-row fills don't share
+        # prefill's single resume boundary.
+        pc = self.prefix_cache
+        use_cache = pc is not None and not spec_on
+        matches = None
+        cache_report = [0] * len(encoded)
+        if use_cache:
+            t_cl = time.time()
+            t_cl_m = time.monotonic() if tracing else 0.0
+            matches = [
+                pc.match(ids, max_tokens=len(ids) - 1) for ids in encoded
+            ]
+            if tracing:
+                emit("cache_lookup", t_cl_m, time.time() - t_cl,
+                     prompts=len(encoded),
+                     hit_tokens=sum(m.tokens for m in matches))
+            order = sorted(
+                range(len(encoded)),
+                key=lambda i: (len(encoded[i]) - matches[i].tokens,
+                               len(encoded[i])),
+            )
+        else:
+            # group indices by bucketed length, then emit fixed-shape batches
+            order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
         results: list[str | None] = [None] * len(encoded)
         t0 = time.time()
         # the segmented path only pays off when the budget spans multiple
@@ -1217,47 +1500,93 @@ class TpuBackend:
         continuous = self.continuous and (
             self.instrument or max_new > self.segment_tokens
         )
-        for start in range(0, len(order), self.batch_size):
-            group = order[start : start + self.batch_size]
-            seed = self._next_seed(gen)
-            # per-GROUP spec routing: a coalesced batch can mix referenced
-            # and reference-less requests, and length-sorting may put all
-            # the refless ones in one group — that group would pay the
-            # (k+1)-wide verify forward to retire one token per step, so it
-            # takes the plain path instead (identical greedy output either
-            # way; its spec_report rows stay zero)
-            if spec_on and any(references[i] for i in group):
-                self._run_group_spec(
-                    group, encoded, references, max_new, gen, results,
-                    spec_report, seed,
+        try:
+            for start in range(0, len(order), self.batch_size):
+                group = order[start : start + self.batch_size]
+                seed = self._next_seed(gen)
+                # per-GROUP spec routing: a coalesced batch can mix
+                # referenced and reference-less requests, and length-sorting
+                # may put all the refless ones in one group — that group
+                # would pay the (k+1)-wide verify forward to retire one
+                # token per step, so it takes the plain path instead
+                # (identical greedy output either way; its spec_report rows
+                # stay zero)
+                if spec_on and any(references[i] for i in group):
+                    self._run_group_spec(
+                        group, encoded, references, max_new, gen, results,
+                        spec_report, seed,
+                    )
+                    continue
+                tokens, pad_lens, B, S = self._pack_group(
+                    group, encoded, max_new
                 )
-                continue
-            if continuous:
-                self._run_group_continuous(
-                    group, encoded, max_new, gen, results, seed
+                resume = None
+                if matches is not None:
+                    resume = self._prepare_resume(
+                        group, encoded, matches, pad_lens, B, S, max_new,
+                        tracing,
+                    )
+                if resume is not None:
+                    for row, i in enumerate(group):
+                        cache_report[i] = resume[2][row]
+                insert_cb = None
+                if use_cache:
+                    def insert_cb(cache, _g=group, _p=pad_lens):
+                        self._cache_insert(
+                            cache, _g, encoded, matches, cache_hints, _p,
+                            tracing,
+                        )
+                if continuous:
+                    self._run_group_continuous(
+                        group, encoded, max_new, gen, results, seed,
+                        packed=(tokens, pad_lens, B, S),
+                        resume=resume and resume[:2], insert_cb=insert_cb,
+                    )
+                    continue
+                K = resume[0] if resume else 0
+                fn = self._get_fn(B, S, max_new, gen, resume_from=K)
+                t_disp = time.monotonic() if tracing else 0.0
+                with annotate(f"generate[B={B},S={S}]"):
+                    if K:
+                        res = fn(self.params, tokens, pad_lens, seed,
+                                 resume[1])
+                    else:
+                        res = fn(self.params, tokens, pad_lens, seed)
+                    # with the prefix cache on, the program also returns its
+                    # final cache so new prefix blocks can be pooled
+                    out_dev, final_cache = res if pc is not None else (res, None)
+                    out = np.asarray(out_dev)
+                # the fused prefill+decode program has no observable
+                # midpoint: one "dispatch" event bounds the whole device
+                # call (the result fetch above synced it) — TTFT consumers
+                # treat its end as the first-token upper bound
+                if tracing:
+                    emit("dispatch", t_disp, time.monotonic() - t_disp,
+                         B=B, S=S, occupancy=len(group), max_new=max_new)
+                self.stats.batches += 1
+                self.stats.by_bucket[(B, S)] = (
+                    self.stats.by_bucket.get((B, S), 0) + 1
                 )
-                continue
-            tokens, pad_lens, B, S = self._pack_group(group, encoded, max_new)
-            fn = self._get_fn(B, S, max_new, gen)
-            t_disp = time.monotonic() if tracing else 0.0
-            with annotate(f"generate[B={B},S={S}]"):
-                out = np.asarray(fn(self.params, tokens, pad_lens, seed))
-            # the fused prefill+decode program has no observable midpoint:
-            # one "dispatch" event bounds the whole device call (the result
-            # fetch above synced it) — TTFT consumers treat its end as the
-            # first-token upper bound
-            if tracing:
-                emit("dispatch", t_disp, time.monotonic() - t_disp,
-                     B=B, S=S, occupancy=len(group), max_new=max_new)
-            self.stats.batches += 1
-            self.stats.by_bucket[(B, S)] = self.stats.by_bucket.get((B, S), 0) + 1
-            t_detok = time.monotonic() if tracing else 0.0
-            for row, i in enumerate(group):
-                results[i] = self._detok(out[row], tuple(gen.eos_ids))
-            if tracing:
-                emit("detokenize", t_detok, time.monotonic() - t_detok,
-                     rows=len(group))
+                if insert_cb is not None:
+                    insert_cb(final_cache)
+                t_detok = time.monotonic() if tracing else 0.0
+                for row, i in enumerate(group):
+                    results[i] = self._detok(out[row], tuple(gen.eos_ids))
+                if tracing:
+                    emit("detokenize", t_detok, time.monotonic() - t_detok,
+                         rows=len(group))
+        finally:
+            if matches is not None:
+                for m in matches:
+                    pc.release(m)
         self.stats.generate_seconds += time.time() - t0
+        if use_cache:
+            hit = sum(cache_report)
+            self.stats.cache_hit_tokens += hit
+            self.stats.cache_miss_tokens += (
+                sum(len(e) for e in encoded) - hit
+            )
+        self._cache_report = cache_report if use_cache else []
         if spec_on:
             from ..spec import SpecRecord
 

@@ -22,6 +22,16 @@ Speculative-decoding plumbing (vnsum_tpu.spec) is mirrored synthetically:
 SpecRecord at the configured ``spec_acceptance`` rate, retrievable once via
 ``take_spec_report()`` — the same contract TpuBackend exposes — so serve
 and strategy tests can exercise acceptance-rate metrics without a model.
+
+The prefix KV cache (vnsum_tpu.cache) is mirrored the same way:
+``prefix_cache_blocks > 0`` runs the REAL radix index (cache/radix.py) over
+whitespace words — block matching, ref-counted pins, LRU eviction — with no
+device pool behind it. ``cache_hints`` bound insertion exactly like the
+engine, hit counts flow through ``take_cache_report()`` /
+``cached_prefix_tokens()`` / ``prefix_cache_stats()``, and the optional
+``per_token_s`` latency term scales the simulated prefill sleep with
+UNCACHED tokens only, so hermetic serving benches see real TTFT improvement
+from cache hits (scripts/bench_serving.py --shared-prefix arm).
 """
 from __future__ import annotations
 
@@ -49,22 +59,40 @@ class FakeBackend:
         prefix: str = "",
         batch_overhead_s: float = 0.0,
         per_prompt_s: float = 0.0,
+        per_token_s: float = 0.0,
         spec_k: int = 0,
         spec_acceptance: float = 0.5,
+        prefix_cache_blocks: int = 0,
+        cache_block_tokens: int = 8,
     ) -> None:
         self._responses = list(responses) if responses else None
         self.summary_words = summary_words
         self.prefix = prefix
         self.batch_overhead_s = batch_overhead_s
         self.per_prompt_s = per_prompt_s
+        # per-UNCACHED-prompt-token prefill cost: the lever that makes
+        # prefix-cache hits show up as TTFT/goodput improvement hermetically
+        self.per_token_s = per_token_s
         # default spec_k applied when a call's config doesn't carry one —
         # mirrors TpuBackend's generation=GenerationConfig(spec_k=...)
         self.spec_k = spec_k
         self.spec_acceptance = spec_acceptance
+        # synthetic prefix cache: the real radix index over whitespace
+        # words, matching TpuBackend's hit/insert/evict dynamics without a
+        # device pool (tokens here are words, consistent with count_tokens)
+        self.prefix_index = None
+        if prefix_cache_blocks:
+            from ..cache.radix import RadixIndex
+
+            self.prefix_index = RadixIndex(
+                prefix_cache_blocks, cache_block_tokens
+            )
         self.calls: list[str] = []
         self.batch_sizes: list[int] = []
         self.references_seen: list[str | None] = []
+        self.cache_hints_seen: list[str | None] = []
         self._spec_report: list[SpecRecord] = []
+        self._cache_report: list[int] = []
 
     def _one(self, prompt: str) -> str:
         if self._responses is not None:
@@ -76,6 +104,43 @@ class FakeBackend:
         words = source.split()
         return self.prefix + " ".join(words[: self.summary_words])
 
+    def _cache_pass(
+        self,
+        prompts: list[str],
+        cache_hints: list[str | None] | None,
+    ) -> int:
+        """Match then insert, mirroring the engine's per-call order: ALL
+        prompts match up front (pinned), insertion follows — so duplicates
+        within one call miss together, exactly like a shared engine batch.
+        Returns total UNCACHED tokens for the latency model; fills
+        _cache_report with per-prompt hit counts."""
+        idx = self.prefix_index
+        words_per = [p.split() for p in prompts]
+        matches = [
+            idx.match(w, max_tokens=len(w) - 1) for w in words_per
+        ]
+        for i, (w, m) in enumerate(zip(words_per, matches)):
+            hint = cache_hints[i] if cache_hints else None
+            if hint:
+                # mirror the engine's _hint_prefix_len: the hint bounds
+                # insertion only up to its true common prefix with the
+                # prompt — a hint the prompt doesn't start with caches
+                # nothing, instead of caching unique content by length
+                hw = hint.split()
+                upto = 0
+                while (
+                    upto < min(len(hw), len(w)) and hw[upto] == w[upto]
+                ):
+                    upto += 1
+            else:
+                upto = len(w) - 1
+            idx.insert(w, min(upto, len(w) - 1))
+            idx.release(m)
+        self._cache_report = [m.tokens for m in matches]
+        return sum(
+            len(w) - m.tokens for w, m in zip(words_per, matches)
+        )
+
     def generate(
         self,
         prompts: list[str],
@@ -83,23 +148,34 @@ class FakeBackend:
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
         references: list[str | None] | None = None,
+        cache_hints: list[str | None] | None = None,
     ) -> list[str]:
         self.calls.extend(prompts)
         self.batch_sizes.append(len(prompts))
         self.references_seen.extend(
             references if references is not None else [None] * len(prompts)
         )
+        self.cache_hints_seen.extend(
+            cache_hints if cache_hints is not None else [None] * len(prompts)
+        )
+        if self.prefix_index is not None:
+            uncached = self._cache_pass(prompts, cache_hints)
+        else:
+            uncached = sum(len(p.split()) for p in prompts)
+            self._cache_report = []
         t0 = time.monotonic() if current_collector() is not None else 0.0
-        if self.batch_overhead_s or self.per_prompt_s:
-            time.sleep(self.batch_overhead_s + self.per_prompt_s * len(prompts))
+        prefill_s = self.batch_overhead_s + self.per_token_s * uncached
+        if prefill_s or self.per_prompt_s:
+            time.sleep(prefill_s + self.per_prompt_s * len(prompts))
         # engine-telemetry contract mirror: the latency model's fixed
-        # per-dispatch cost plays the prefill phase and the marginal
-        # per-row cost plays decode, so hermetic serving runs get the same
-        # prefill/decode structure (and TTFT anchor) TpuBackend emits —
-        # emit() is a no-op unless the scheduler installed a BatchTrace
+        # per-dispatch cost (plus the per-uncached-token prefill term) plays
+        # the prefill phase and the marginal per-row cost plays decode, so
+        # hermetic serving runs get the same prefill/decode structure (and
+        # TTFT anchor) TpuBackend emits — emit() is a no-op unless the
+        # scheduler installed a BatchTrace
         if t0:
-            emit("prefill", t0, self.batch_overhead_s, B=len(prompts))
-            emit("decode", t0 + self.batch_overhead_s,
+            emit("prefill", t0, prefill_s, B=len(prompts))
+            emit("decode", t0 + prefill_s,
                  self.per_prompt_s * len(prompts), B=len(prompts))
         outs = [self._one(p) for p in prompts]
         k = config.spec_k if config is not None else self.spec_k
@@ -127,6 +203,26 @@ class FakeBackend:
         the serving scheduler attributes acceptance metrics through."""
         report, self._spec_report = self._spec_report, []
         return report
+
+    def take_cache_report(self) -> list[int]:
+        """Per-prompt prefix-cache hit tokens of the LAST generate call
+        (empty when the cache is off), cleared on read — the same
+        attribution hook TpuBackend exposes."""
+        report, self._cache_report = self._cache_report, []
+        return report
+
+    def cached_prefix_tokens(self, text: str, cache_hint: str | None = None) -> int:
+        """Read-only probe in whitespace-word tokens (consistent with
+        count_tokens) — the admission-discount hook."""
+        if self.prefix_index is None:
+            return 0
+        words = text.split()
+        return self.prefix_index.probe(words, max_tokens=len(words) - 1)
+
+    def prefix_cache_stats(self) -> dict | None:
+        if self.prefix_index is None:
+            return None
+        return self.prefix_index.stats_dict()
 
     def count_tokens(self, text: str) -> int:
         return whitespace_token_count(text)

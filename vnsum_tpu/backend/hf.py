@@ -92,6 +92,7 @@ class HFBackend:
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
         references: list[str | None] | None = None,  # spec metadata; unused
+        cache_hints: list[str | None] | None = None,  # cache metadata; unused
     ) -> list[str]:
         torch = self._torch
         max_new = resolve_max_new(max_new_tokens, config, self.max_new_tokens)

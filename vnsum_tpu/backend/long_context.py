@@ -563,6 +563,7 @@ class LongContextBackend:
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
         references: list[str | None] | None = None,  # spec metadata; unused
+        cache_hints: list[str | None] | None = None,  # cache metadata; unused
     ) -> list[str]:
         gen = config or self.gen_cfg
         max_new = resolve_max_new(max_new_tokens, gen, self.max_new_tokens)

@@ -135,6 +135,7 @@ class OllamaBackend:
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
         references: list[str | None] | None = None,  # spec metadata; unused
+        cache_hints: list[str | None] | None = None,  # cache metadata; unused
     ) -> list[str]:
         max_new = resolve_max_new(max_new_tokens, config, self.max_new_tokens)
         if len(prompts) == 1:

@@ -1,0 +1,25 @@
+"""Radix prefix KV cache — cross-request prompt reuse for prefill.
+
+Every prompt this system prefills is prefix-redundant by construction: map
+chunks share a template header (strategies/prompts.py), iterative refinement
+re-feeds the prior summary, hierarchical collapse re-feeds child summaries.
+This package caches the KV of already-prefilled token prefixes so later
+requests prefill only their suffix (survey arXiv:2405.13019 §KV-cache reuse):
+
+- :mod:`radix` — the host-side token-id radix index at block granularity,
+  with ref-counting (live batches pin their matched blocks) and LRU eviction
+  under a fixed block budget;
+- :mod:`store` — the device-side paged block pool (one [L, KV, BLK, hd]
+  slab per block, mirroring the stacked cache layout of models/llama.py)
+  plus :class:`~vnsum_tpu.cache.store.PrefixCache`, the engine-facing facade
+  combining both.
+
+Greedy outputs on the resume-prefill path are byte-identical to the uncached
+path on same-shape replays (the same caveat as decode compaction,
+backend/engine.py): cached K/V are bitwise copies of what full prefill wrote,
+and the suffix forward computes the same math over the same cache length.
+"""
+from .radix import CacheStats, Match, RadixIndex
+from .store import BlockStore, PrefixCache
+
+__all__ = ["BlockStore", "CacheStats", "Match", "PrefixCache", "RadixIndex"]

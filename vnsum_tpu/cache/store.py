@@ -1,0 +1,218 @@
+"""Paged KV block store + the engine-facing PrefixCache facade.
+
+The pool mirrors the stacked cache layout the attention kernels consume
+(models/llama.py init_kv_cache: [L, B, KV, C, hd], scales [L, B, KV, C]):
+one pool row per block, [L, KV, BLK, hd] (and [L, KV, BLK] for int8-KV
+scales), so extraction and gather are pure layout-preserving copies — no
+transpose ever materializes on device.
+
+Blocks are POSITION-CONTIGUOUS: a block holds the KV of BLK consecutive
+prompt tokens at RoPE positions [off, off + BLK), independent of where the
+row sat in its producer batch. Left-padded batches place token position p of
+a row at cache slot pad + p (models/llama.py prefill_positions), so a block
+extracted at slot pad_src + off pastes into any consumer row at slot
+pad_dst + off — the positions line up by construction, which is what makes
+cross-request, cross-bucket reuse sound.
+
+Two device ops, both jitted per cache-shape bucket:
+
+- :meth:`BlockStore.write_block` — copy one block slab out of a batch row
+  into the pool (insertion after prefill); one dispatch per block keeps the
+  copies clamp-free for any slot alignment.
+- :meth:`BlockStore.gather` — vmapped per-row ``dynamic_update_slice`` of up
+  to NB blocks into a fresh batch cache at per-row slot offsets (the same
+  per-row ragged-write shape as llama._cache_write's vector path). Rows
+  needing fewer blocks pad with the scratch block id; those writes land at
+  slots the suffix prefill overwrites (or a filler row nobody reads), so
+  padding is harmless by construction — see backend/engine.py's resume path
+  for the slot arithmetic that guarantees it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .radix import Match, RadixIndex
+
+
+def _pow2_at_least(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class BlockStore:
+    """Device pool of ``num_blocks`` KV blocks (+1 scratch row used as the
+    padding target for ragged gathers; the radix index never hands it out)."""
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_tokens: int,
+        *,
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype,
+        quantized: bool = False,
+    ) -> None:
+        import jax.numpy as jnp
+
+        self.block_tokens = block_tokens
+        self.scratch_id = num_blocks
+        N = num_blocks + 1
+        shape = (N, n_layers, n_kv_heads, block_tokens, head_dim)
+        if quantized:
+            self.pool = {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.zeros(shape[:-1], jnp.float32),
+                "vs": jnp.zeros(shape[:-1], jnp.float32),
+            }
+        else:
+            self.pool = {
+                "k": jnp.zeros(shape, dtype),
+                "v": jnp.zeros(shape, dtype),
+            }
+        self._write_fns: dict = {}
+        self._gather_fns: dict = {}
+
+    @property
+    def hbm_bytes(self) -> int:
+        return sum(v.size * v.dtype.itemsize for v in self.pool.values())
+
+    @staticmethod
+    def _shape_sig(cache: dict) -> tuple:
+        return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in cache.items()))
+
+    # -- insertion -------------------------------------------------------
+
+    def write_block(self, cache: dict, row: int, slot: int, block_id: int) -> None:
+        """Copy the [slot, slot+BLK) slab of batch ``row`` into pool block
+        ``block_id``. One small device-to-device copy; per-block dispatch
+        means no padded slice can ever clamp onto neighbouring slots."""
+        import jax
+        import jax.numpy as jnp
+
+        BLK = self.block_tokens
+        key = self._shape_sig(cache)
+        fn = self._write_fns.get(key)
+        if fn is None:
+
+            def write(pool, cache, row, slot, bid):
+                out = {}
+                for name, buf in cache.items():
+                    # [L, B, KV, C(, hd)] -> slab [L, KV, BLK(, hd)]
+                    L, _, KV = buf.shape[:3]
+                    tail = buf.shape[4:]
+                    sizes = (L, 1, KV, BLK) + tail
+                    starts = (0, row, 0, slot) + (0,) * len(tail)
+                    slab = jax.lax.dynamic_slice(buf, starts, sizes)[:, 0]
+                    out[name] = jax.lax.dynamic_update_slice(
+                        pool[name], slab[None],
+                        (bid,) + (0,) * (pool[name].ndim - 1),
+                    )
+                return out
+
+            fn = jax.jit(write, donate_argnums=(0,))
+            self._write_fns[key] = fn
+        self.pool = fn(
+            self.pool, cache,
+            jnp.int32(row), jnp.int32(slot), jnp.int32(block_id),
+        )
+
+    # -- gather ----------------------------------------------------------
+
+    def gather(self, cache: dict, block_ids: np.ndarray, starts: np.ndarray) -> dict:
+        """Seed ``cache`` (a fresh [L, B, KV, C, hd] batch cache) with pool
+        blocks: row b gets block_ids[b, i] written at slot starts[b] + i*BLK.
+        ``block_ids`` is [B, NB'] (any NB'); it is padded to a power-of-two
+        NB with the scratch id to bound compiled-program fan-out."""
+        import jax
+        import jax.numpy as jnp
+
+        BLK = self.block_tokens
+        B, nb = block_ids.shape
+        NB = _pow2_at_least(max(nb, 1))
+        ids = np.full((B, NB), self.scratch_id, dtype=np.int32)
+        ids[:, :nb] = block_ids
+        key = (B, NB, self._shape_sig(cache))
+        fn = self._gather_fns.get(key)
+        if fn is None:
+
+            def per_row(pool, row_cache, row_ids, start):
+                for i in range(NB):
+                    for name in row_cache:
+                        blk = pool[name][row_ids[i]]  # [L, KV, BLK(, hd)]
+                        nd = row_cache[name].ndim
+                        idx = (0, 0, start + i * BLK) + (0,) * (nd - 3)
+                        row_cache[name] = jax.lax.dynamic_update_slice(
+                            row_cache[name], blk, idx
+                        )
+                return row_cache
+
+            def gather_fn(pool, cache, ids, starts):
+                return jax.vmap(
+                    per_row, in_axes=(None, 1, 0, 0), out_axes=1
+                )(pool, cache, ids, starts)
+
+            fn = jax.jit(gather_fn, donate_argnums=(1,))
+            self._gather_fns[key] = fn
+        return fn(
+            self.pool, cache, jnp.asarray(ids),
+            jnp.asarray(starts, dtype=jnp.int32),
+        )
+
+
+class PrefixCache:
+    """Radix index + block store, the one object the engine talks to.
+
+    Single engine thread does all mutation (match-with-pin, gather, insert);
+    other threads may only :meth:`probe` — the contract inherited from
+    cache/radix.py."""
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_tokens: int,
+        *,
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype,
+        quantized: bool = False,
+    ) -> None:
+        self.block_tokens = block_tokens
+        self.index = RadixIndex(num_blocks, block_tokens)
+        self.store = BlockStore(
+            num_blocks, block_tokens, n_layers=n_layers,
+            n_kv_heads=n_kv_heads, head_dim=head_dim, dtype=dtype,
+            quantized=quantized,
+        )
+
+    def match(self, ids, max_tokens: int | None = None) -> Match:
+        return self.index.match(ids, max_tokens)
+
+    def release(self, match: Match) -> None:
+        self.index.release(match)
+
+    def probe(self, ids, max_tokens: int | None = None) -> int:
+        return self.index.probe(ids, max_tokens)
+
+    def gather(self, cache: dict, block_ids, starts) -> dict:
+        return self.store.gather(cache, block_ids, starts)
+
+    def insert(self, cache: dict, row: int, slot_base: int, ids, upto: int) -> int:
+        """Index tokens[:upto] of a freshly prefilled row and copy the newly
+        allocated blocks' KV out of ``cache`` (whose row sits left-padded at
+        ``slot_base``). Returns the number of new blocks written."""
+        new = self.index.insert(ids, upto)
+        for block, off in new:
+            self.store.write_block(cache, row, slot_base + off, block)
+        return len(new)
+
+    def stats_dict(self) -> dict:
+        d = self.index.stats_dict()
+        d["block_tokens"] = self.block_tokens
+        d["hbm_bytes"] = self.store.hbm_bytes
+        return d

@@ -111,14 +111,27 @@ class ServeRequestRecord:
     draft_tokens: int = 0
     accepted_tokens: int = 0
     spec_steps: int = 0
+    # radix prefix KV cache (vnsum_tpu.cache): prompt tokens whose prefill
+    # was served from cached prefix blocks, attributed from the backend's
+    # take_cache_report hook (0 when the cache is off or the prompt missed)
+    cached_prompt_tokens: int = 0
 
     @property
     def acceptance_rate(self) -> float:
         return self.accepted_tokens / self.draft_tokens if self.draft_tokens else 0.0
 
+    @property
+    def cache_hit_rate(self) -> float:
+        """Fraction of this request's prompt tokens served from the prefix
+        cache."""
+        if not self.prompt_tokens:
+            return 0.0
+        return min(self.cached_prompt_tokens / self.prompt_tokens, 1.0)
+
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["acceptance_rate"] = round(self.acceptance_rate, 6)
+        d["cache_hit_rate"] = round(self.cache_hit_rate, 6)
         return d
 
 
@@ -140,6 +153,8 @@ class ServingStats:
     # speculative decoding aggregates (sums of the per-request fields)
     draft_tokens: int = 0
     accepted_tokens: int = 0
+    # prefix KV cache aggregate: prompt tokens served from cached blocks
+    cache_hit_tokens: int = 0
 
     @property
     def shed_total(self) -> int:
@@ -148,6 +163,12 @@ class ServingStats:
     @property
     def acceptance_rate(self) -> float:
         return self.accepted_tokens / self.draft_tokens if self.draft_tokens else 0.0
+
+    @property
+    def cache_hit_rate(self) -> float:
+        if not self.prompt_tokens:
+            return 0.0
+        return min(self.cache_hit_tokens / self.prompt_tokens, 1.0)
 
     @property
     def avg_batch_occupancy(self) -> float:
@@ -164,6 +185,7 @@ class ServingStats:
         d["avg_batch_occupancy"] = self.avg_batch_occupancy
         d["tokens_per_second"] = self.tokens_per_second
         d["acceptance_rate"] = round(self.acceptance_rate, 6)
+        d["cache_hit_rate"] = round(self.cache_hit_rate, 6)
         return d
 
 

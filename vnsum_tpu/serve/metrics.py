@@ -77,8 +77,18 @@ _reg("spec_acceptance_rate", "gauge",
      "cumulative accepted / drafted tokens (0 when spec is off)")
 _reg("spec_acceptance_rolling", "gauge",
      "accepted / drafted tokens over the last 256 requests")
+_reg("cache_hit_tokens_total", "counter",
+     "prompt tokens whose prefill was served from the prefix KV cache")
+_reg("cache_hit_rate", "gauge",
+     "cumulative cache-hit tokens / prompt tokens (0 when the cache is off)")
+_reg("cache_evictions_total", "counter",
+     "prefix-cache blocks evicted (LRU under the block budget)")
+_reg("cache_blocks_used", "gauge",
+     "prefix-cache blocks currently allocated")
+_reg("cache_blocks_total", "gauge", "prefix-cache block budget")
 _reg("queue_depth", "gauge", "requests currently queued")
-_reg("queued_tokens", "gauge", "prompt-token estimate currently queued")
+_reg("queued_tokens", "gauge",
+     "billable (uncached) prompt-token estimate currently queued")
 _reg("queue_wait_seconds", "histogram",
      "queue wait (submit -> engine dispatch)")
 _reg("ttft_seconds", "histogram",
@@ -150,6 +160,7 @@ class ServeMetrics:
             self._stats.generated_tokens += rec.generated_tokens
             self._stats.draft_tokens += rec.draft_tokens
             self._stats.accepted_tokens += rec.accepted_tokens
+            self._stats.cache_hit_tokens += rec.cached_prompt_tokens
             self._hists["queue_wait_seconds"].observe(rec.queue_wait_s)
             if rec.status == "ok":
                 # only anchored TTFT (a real prefill-end timestamp from the
@@ -179,7 +190,11 @@ class ServeMetrics:
             return {k: h.to_dict() for k, h in self._hists.items()}
 
     def render_prometheus(self, queue_depth: int | None = None,
-                          queued_tokens: int | None = None) -> str:
+                          queued_tokens: int | None = None,
+                          cache_stats: dict | None = None) -> str:
+        """``cache_stats`` is the backend's prefix_cache_stats() snapshot
+        (evictions / blocks_used / blocks_total), read at scrape time like
+        the queue gauges — the serving layer never mirrors pool state."""
         import copy
 
         # one lock acquisition for stats AND histograms: a scrape must not
@@ -221,6 +236,12 @@ class ServeMetrics:
         simple("spec_accepted_tokens_total", s.accepted_tokens)
         simple("spec_acceptance_rate", round(s.acceptance_rate, 6))
         simple("spec_acceptance_rolling", round(rolling_accept, 6))
+        simple("cache_hit_tokens_total", s.cache_hit_tokens)
+        simple("cache_hit_rate", round(s.cache_hit_rate, 6))
+        if cache_stats is not None:
+            simple("cache_evictions_total", cache_stats.get("evictions", 0))
+            simple("cache_blocks_used", cache_stats.get("blocks_used", 0))
+            simple("cache_blocks_total", cache_stats.get("blocks_total", 0))
         if queue_depth is not None:
             simple("queue_depth", queue_depth)
         if queued_tokens is not None:

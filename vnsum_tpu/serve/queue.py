@@ -62,6 +62,17 @@ class ServeRequest:
     # per-ROW metadata, so it never enters batch_key — requests with
     # different references still coalesce
     reference: str | None = None
+    # prefix-cache hint (vnsum_tpu.cache): the prompt prefix the caller
+    # expects to recur. Per-ROW metadata like reference — never part of
+    # batch_key — but take_batch uses it to CLUSTER compatible requests so
+    # shared-prefix rows land in the same engine batch (the engine's usable
+    # skip is bounded by the batch's coldest row)
+    cache_hint: str | None = None
+    # tokens of this prompt the backend's prefix cache already holds
+    # (cached_prefix_tokens probe at submit); admission control bills only
+    # the difference — a cached 10k-token header shouldn't crowd out work
+    # the engine will never actually prefill
+    cached_tokens: int = 0
     # absolute time.monotonic() deadline; None = no SLO
     deadline: float | None = None
     est_tokens: int = 0
@@ -87,6 +98,12 @@ class ServeRequest:
         if not self.trace_id:
             self.trace_id = f"req-{self.request_id}"
 
+    @property
+    def billable_tokens(self) -> int:
+        """Prompt tokens the engine will actually prefill — what the
+        admission token budget counts."""
+        return max(self.est_tokens - self.cached_tokens, 0)
+
     def batch_key(self) -> tuple:
         """Requests sharing this key can ride one engine batch: the engine
         applies max_new_tokens and the GenerationConfig per CALL, not per
@@ -106,7 +123,10 @@ class RequestQueue:
     ``max_depth`` bounds queued requests; ``max_queued_tokens`` (0 =
     unlimited) bounds the sum of queued prompt-token estimates so a few
     book-length prompts can't squeeze out hundreds of short ones while
-    nominally fitting the depth budget."""
+    nominally fitting the depth budget. The estimate is each request's
+    BILLABLE tokens — prompt tokens minus its prefix-cache coverage — so
+    cached template headers don't consume admission budget the engine will
+    never spend prefilling."""
 
     def __init__(self, max_depth: int = 256, max_queued_tokens: int = 0) -> None:
         self.max_depth = max_depth
@@ -140,11 +160,11 @@ class RequestQueue:
             if req.expired():
                 self._shed_locked(req, ShedReason.DEADLINE)
             if not force:
-                reason = self._admission_reason_locked(req.est_tokens)
+                reason = self._admission_reason_locked(req.billable_tokens)
                 if reason is not None:
                     self._shed_locked(req, reason)
             self._items.append(req)
-            self._queued_tokens += req.est_tokens
+            self._queued_tokens += req.billable_tokens
             if self.on_admit is not None:
                 self.on_admit(req)
             self._cond.notify_all()
@@ -190,7 +210,7 @@ class RequestQueue:
         live = []
         for r in self._items:
             if r.expired(now):
-                self._queued_tokens -= r.est_tokens
+                self._queued_tokens -= r.billable_tokens
                 if self.on_shed is not None:
                     self.on_shed(r, ShedReason.DEADLINE)
                 if not r.future.done():
@@ -228,13 +248,26 @@ class RequestQueue:
                 head = self._items[0]
                 key = head.batch_key()
                 compat = [r for r in self._items if r.batch_key() == key]
+                # prefix-cache clustering (vnsum_tpu.cache): when more
+                # compatible requests wait than one batch holds, fill it
+                # with the head's cache_hint group first — the engine's
+                # usable prefill skip is bounded by the batch's coldest
+                # row, so mixing hint groups wastes everyone's cached
+                # prefix. FIFO order is preserved within each part, and
+                # nothing reorders when the batch drains everyone anyway.
+                if len(compat) > max_batch and any(r.cache_hint for r in compat):
+                    hint = head.cache_hint
+                    compat = (
+                        [r for r in compat if r.cache_hint == hint]
+                        + [r for r in compat if r.cache_hint != hint]
+                    )
                 flush_at = max(head.enqueued_at, t_enter) + max_wait_s
                 if len(compat) >= max_batch or now >= flush_at or self._closed:
                     batch = compat[:max_batch]
                     taken = set(id(r) for r in batch)
                     self._items = [r for r in self._items if id(r) not in taken]
                     for r in batch:
-                        self._queued_tokens -= r.est_tokens
+                        self._queued_tokens -= r.billable_tokens
                     return batch
                 self._cond.wait(timeout=max(flush_at - now, 0.001))
 
@@ -247,7 +280,7 @@ class RequestQueue:
             self._closed = True
             if not drain:
                 for r in self._items:
-                    self._queued_tokens -= r.est_tokens
+                    self._queued_tokens -= r.billable_tokens
                     if self.on_shed is not None:
                         self.on_shed(r, ShedReason.SHUTDOWN)
                     if not r.future.done():

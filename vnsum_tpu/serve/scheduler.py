@@ -109,6 +109,7 @@ class MicroBatchScheduler:
         deadline: float | None = None,
         internal: bool = False,
         reference: str | None = None,
+        cache_hint: str | None = None,
         trace: RequestTrace | None = None,
         trace_id: str | None = None,
         trace_owned: bool = False,
@@ -119,7 +120,12 @@ class MicroBatchScheduler:
         rounds riding a QueuedBackend): depth/token admission is skipped —
         the request-level gate is check_admission — while deadline and
         shutdown shedding still apply. ``reference`` rides the request as
-        per-row speculation metadata (never part of the batch key).
+        per-row speculation metadata (never part of the batch key);
+        ``cache_hint`` rides the same way for the prefix KV cache — it
+        bounds backend block insertion AND clusters shared-prefix requests
+        into the same engine batch (queue.take_batch). When the backend
+        exposes a prefix cache and a token budget is configured, the
+        request is billed only its UNCACHED tokens at admission.
 
         Tracing: an entry point that already owns a RequestTrace (the HTTP
         layer, a strategy's QueuedBackend) passes it via ``trace`` — this
@@ -137,10 +143,21 @@ class MicroBatchScheduler:
             max_new_tokens=max_new_tokens,
             config=config,
             reference=reference,
+            cache_hint=cache_hint,
             deadline=deadline,
             est_tokens=self.backend.count_tokens(prompt),
             trace_id=trace_id or "",
         )
+        # admission discount: only probed when a token budget exists — the
+        # probe re-tokenizes the prompt (a second pass on top of
+        # count_tokens above; acceptable because the path is opt-in and a
+        # cache-less backend short-circuits before encoding anything)
+        if self.queue.max_queued_tokens:
+            probe = getattr(self.backend, "cached_prefix_tokens", None)
+            if callable(probe):
+                req.cached_tokens = min(
+                    probe(prompt, cache_hint), req.est_tokens
+                )
         if trace is not None:
             req.trace = trace
             req.trace_track = trace.next_track()
@@ -162,18 +179,20 @@ class MicroBatchScheduler:
             self.metrics.observe_shed(e.reason)
             raise
 
-    def submit_many(self, prompts, references=None, **kw):
+    def submit_many(self, prompts, references=None, cache_hints=None, **kw):
         """Admit a round of prompts atomically-ish: if any prompt is shed at
         admission, already-admitted siblings are left to complete (they
         occupy queue slots either way) and the shed propagates to the
         caller — a strategy round is all-or-nothing for its caller.
         ``references`` optionally aligns one speculation reference per
-        prompt."""
+        prompt; ``cache_hints`` one prefix-cache hint per prompt."""
         if references is None:
             references = [None] * len(prompts)
+        if cache_hints is None:
+            cache_hints = [None] * len(prompts)
         return [
-            self.submit(p, reference=r, **kw)
-            for p, r in zip(prompts, references)
+            self.submit(p, reference=r, cache_hint=h, **kw)
+            for p, r, h in zip(prompts, references, cache_hints)
         ]
 
     def generate_sync(
@@ -185,12 +204,14 @@ class MicroBatchScheduler:
         deadline: float | None = None,
         internal: bool = False,
         references: list[str | None] | None = None,
+        cache_hints: list[str | None] | None = None,
         trace: RequestTrace | None = None,
         trace_id: str | None = None,
         trace_owned: bool = False,
     ) -> list[_Completion]:
         futs = self.submit_many(
-            prompts, references=references, max_new_tokens=max_new_tokens,
+            prompts, references=references, cache_hints=cache_hints,
+            max_new_tokens=max_new_tokens,
             config=config, deadline=deadline, internal=internal,
             trace=trace, trace_id=trace_id, trace_owned=trace_owned,
         )
@@ -264,6 +285,7 @@ class MicroBatchScheduler:
                     max_new_tokens=head.max_new_tokens,
                     config=head.config,
                     references=[r.reference for r in batch],
+                    cache_hints=[r.cache_hint for r in batch],
                 )
         except Exception as e:
             engine_s = time.monotonic() - t0
@@ -298,12 +320,21 @@ class MicroBatchScheduler:
         spec_report = take_spec() if callable(take_spec) else []
         if len(spec_report) != len(batch):
             spec_report = [None] * len(batch)
-        for r, out, n_out, spec in zip(batch, outs, gen_tokens, spec_report):
+        # prefix-cache attribution rides the same read-after-generate hook:
+        # per-prompt cached prefill tokens, aligned with the batch
+        take_cache = getattr(self.backend, "take_cache_report", None)
+        cache_report = take_cache() if callable(take_cache) else []
+        if len(cache_report) != len(batch):
+            cache_report = [0] * len(batch)
+        for r, out, n_out, spec, cached in zip(
+            batch, outs, gen_tokens, spec_report, cache_report
+        ):
             rec = self._record(r, "ok", t0, engine_s, len(batch), n_out, bt)
             if spec is not None:
                 rec.draft_tokens = spec.draft_tokens
                 rec.accepted_tokens = spec.accepted_tokens
                 rec.spec_steps = spec.verify_steps
+            rec.cached_prompt_tokens = int(cached)
             self.metrics.observe_request(rec)
             self._trace_request(r, t0, engine_s, bt, "ok")
             if not r.future.done():
@@ -420,6 +451,7 @@ class QueuedBackend:
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
         references: list[str | None] | None = None,
+        cache_hints: list[str | None] | None = None,
     ) -> list[str]:
         if not prompts:
             return []
@@ -431,6 +463,7 @@ class QueuedBackend:
         completions = self.scheduler.generate_sync(
             prompts, max_new_tokens=max_new_tokens, config=config,
             deadline=self.deadline, internal=True, references=references,
+            cache_hints=cache_hints,
             trace=self.trace, trace_id=self.trace_id, trace_owned=True,
         )
         with self._lock:

@@ -13,7 +13,8 @@ Endpoints:
         engine batches.
     POST /v1/generate   {"prompt": str} | {"prompts": [str, ...]},
                         optional "max_new_tokens", "temperature", "top_k",
-                        "top_p", "seed", "deadline_ms", "request_id"
+                        "top_p", "seed", "deadline_ms", "request_id",
+                        "reference"/"references", "cache_hint"/"cache_hints"
         Raw engine call(s) through the queue.
     GET /healthz        liveness + queue depth
     GET /metrics        Prometheus text (serve/metrics.py): counters plus
@@ -274,10 +275,14 @@ def make_handler(state: ServeState):
                     }
                 )
             elif path == "/metrics":
+                cache_stats = getattr(
+                    state.backend, "prefix_cache_stats", lambda: None
+                )()
                 self._text(
                     state.scheduler.metrics.render_prometheus(
                         queue_depth=state.scheduler.queue.depth,
                         queued_tokens=state.scheduler.queue.queued_tokens,
+                        cache_stats=cache_stats,
                     )
                 )
             else:
@@ -349,6 +354,23 @@ def make_handler(state: ServeState):
                     {"error": "'references' must align with prompts"}, 400
                 )
                 return
+            # prefix-cache hints: "cache_hint" (single, applied to every
+            # prompt) or "cache_hints" (aligned; null entries allowed)
+            cache_hints = req.get("cache_hints")
+            if cache_hints is None:
+                hint = req.get("cache_hint")
+                cache_hints = (
+                    [hint] * len(prompts) if isinstance(hint, str) else None
+                )
+            if cache_hints is not None and (
+                not isinstance(cache_hints, list)
+                or len(cache_hints) != len(prompts)
+                or not all(h is None or isinstance(h, str) for h in cache_hints)
+            ):
+                self._json(
+                    {"error": "'cache_hints' must align with prompts"}, 400
+                )
+                return
             try:
                 self._rid = _request_id(req, self.headers)
                 max_new_tokens = _number(req, "max_new_tokens", int, integer=True)
@@ -370,6 +392,7 @@ def make_handler(state: ServeState):
                     config=config,
                     deadline=deadline,
                     references=references,
+                    cache_hints=cache_hints,
                     trace=trace,
                     trace_id=self._rid,
                     # this handler made the sampling decision (trace may be
@@ -519,6 +542,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="reference-guided speculative decoding: draft up to "
                         "K tokens/step from each request's reference text "
                         "(0 = off; greedy outputs are identical either way)")
+    p.add_argument("--cache-blocks", type=int, default=256,
+                   help="radix prefix KV cache: HBM block budget for "
+                        "cross-request prompt-prefix reuse (tpu/fake "
+                        "backends; greedy outputs are identical either way)")
+    p.add_argument("--cache-block-tokens", type=int, default=64,
+                   help="tokens per prefix-cache block (reuse granularity)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the prefix KV cache outright")
     p.add_argument("--trace-sample", type=float, default=1.0,
                    help="fraction of requests recorded into the /debug/trace "
                         "ring (0 disables tracing entirely; histograms on "
@@ -532,6 +563,7 @@ def main(argv: list[str] | None = None) -> int:
                         "captures an XLA device trace alongside")
     args = p.parse_args(argv)
 
+    cache_blocks = 0 if args.no_prefix_cache else args.cache_blocks
     if args.backend == "tpu":
         from ..models import MODEL_REGISTRY
 
@@ -539,13 +571,19 @@ def main(argv: list[str] | None = None) -> int:
             "tpu", model_config=MODEL_REGISTRY[args.model](),
             batch_size=args.max_batch,
             generation=GenerationConfig(spec_k=args.spec_k),
+            cache_blocks=cache_blocks,
+            cache_block_tokens=args.cache_block_tokens,
         )
     elif args.backend == "ollama":
         backend = get_backend("ollama", model=args.model)
     elif args.backend == "hf":
         backend = get_backend("hf", model_name_or_path=args.model)
     else:
-        backend = get_backend("fake", spec_k=args.spec_k)
+        # the fake backend's synthetic cache blocks count whitespace words;
+        # same budget flag, so hermetic dev servers exercise hit/evict paths
+        backend = get_backend(
+            "fake", spec_k=args.spec_k, prefix_cache_blocks=cache_blocks
+        )
 
     state = ServeState(
         backend,

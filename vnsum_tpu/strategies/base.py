@@ -64,28 +64,33 @@ class _BatchCounter:
         prompts: list[str],
         owners: list[int],
         references: list[str | None] | None = None,
+        cache_hints: list[str | None] | None = None,
     ) -> list[str]:
         """``references`` optionally aligns one source text per prompt —
         the seam reference-guided speculative decoding rides (strategies
-        pass the chunk being summarized; backends without speculation
-        ignore it)."""
+        pass the chunk being summarized). ``cache_hints`` aligns one
+        expected-to-recur prompt PREFIX per prompt — the prefix KV cache
+        seam (strategies pass their template header, prompts.py
+        template_header). Backends without either feature ignore them."""
         if not prompts:
             return []
         if len(owners) != len(prompts):
             raise ValueError("owners must tag every prompt")
         if references is not None and len(references) != len(prompts):
             raise ValueError("references must align with prompts")
+        if cache_hints is not None and len(cache_hints) != len(prompts):
+            raise ValueError("cache_hints must align with prompts")
         for o in owners:
             self.calls_by_owner[o] = self.calls_by_owner.get(o, 0) + 1
-        if references is None or not any(references):
-            # keep the legacy call shape for backends (and test doubles)
-            # that predate the references kwarg
-            return self.backend.generate(
-                prompts, max_new_tokens=self.max_new_tokens
-            )
+        # keep the legacy call shape for backends (and test doubles) that
+        # predate the advisory kwargs: pass each only when it carries data
+        kw = {}
+        if references is not None and any(references):
+            kw["references"] = references
+        if cache_hints is not None and any(cache_hints):
+            kw["cache_hints"] = cache_hints
         return self.backend.generate(
-            prompts, max_new_tokens=self.max_new_tokens,
-            references=references,
+            prompts, max_new_tokens=self.max_new_tokens, **kw
         )
 
 

@@ -24,6 +24,7 @@ from .prompts import (
     CRITIQUE_MAP,
     CRITIQUE_REDUCE,
     CRITIQUE_REFINE,
+    template_header,
 )
 
 _REF_JOIN = "\n\n---\n\n"
@@ -81,6 +82,7 @@ class MapReduceCritiqueStrategy:
         summaries = gen(
             [CRITIQUE_REDUCE.format(docs=_tag_sections(texts)) for texts, _, _ in items],
             owners=owners,
+            cache_hints=[template_header(CRITIQUE_REDUCE)] * len(items),
         )
         need = [
             i for i, (_, _, it) in enumerate(items)
@@ -95,6 +97,7 @@ class MapReduceCritiqueStrategy:
                 for i in need
             ],
             owners=[owners[i] for i in need],
+            cache_hints=[template_header(CRITIQUE_CRITIQUE)] * len(need),
         )
         refine_idx: list[int] = []
         refine_prompts: list[str] = []
@@ -110,7 +113,10 @@ class MapReduceCritiqueStrategy:
                     reference_content=_REF_JOIN.join(items[i][1]),
                 )
             )
-        refined_outs = gen(refine_prompts, owners=[owners[i] for i in refine_idx])
+        refined_outs = gen(
+            refine_prompts, owners=[owners[i] for i in refine_idx],
+            cache_hints=[template_header(CRITIQUE_REFINE)] * len(refine_idx),
+        )
         for i, refined in zip(refine_idx, refined_outs):
             summaries[i] = refined
         return summaries
@@ -130,7 +136,10 @@ class MapReduceCritiqueStrategy:
             for di, chunks in enumerate(chunks_per_doc)
             for c in chunks
         ]
-        outs = gen([p for _, p in flat], owners=[di for di, _ in flat])
+        outs = gen(
+            [p for _, p in flat], owners=[di for di, _ in flat],
+            cache_hints=[template_header(CRITIQUE_MAP)] * len(flat),
+        )
         collapsed: list[list[str]] = [[] for _ in docs]
         for (di, _), out in zip(flat, outs):
             collapsed[di].append(out)

@@ -23,7 +23,12 @@ from ..text.tree import (
     tree_depth,
 )
 from .base import StrategyResult, _BatchCounter, register_strategy
-from .prompts import HIERARCHICAL_MAP, HIERARCHICAL_POLISH, HIERARCHICAL_REDUCE
+from .prompts import (
+    HIERARCHICAL_MAP,
+    HIERARCHICAL_POLISH,
+    HIERARCHICAL_REDUCE,
+    template_header,
+)
 
 
 @register_strategy
@@ -77,13 +82,17 @@ class HierarchicalStrategy:
             for ti, chunks in enumerate(chunks_per)
             for c in chunks
         ]
-        outs = gen([p for _, p in flat], owners=[owners[ti] for ti, _ in flat])
+        outs = gen(
+            [p for _, p in flat], owners=[owners[ti] for ti, _ in flat],
+            cache_hints=[template_header(HIERARCHICAL_MAP)] * len(flat),
+        )
         per_text: list[list[str]] = [[] for _ in texts]
         for (ti, _), out in zip(flat, outs):
             per_text[ti].append(out)
         reduces = gen(
             [HIERARCHICAL_REDUCE.format(docs="\n\n".join(s)) for s in per_text],
             owners=owners,
+            cache_hints=[template_header(HIERARCHICAL_REDUCE)] * len(per_text),
         )
         return reduces, [len(c) for c in chunks_per]
 
@@ -133,7 +142,8 @@ class HierarchicalStrategy:
         all_ris = list(range(len(roots)))
         finals, final_counts = self._mapreduce_texts_batch(gen, final_texts, all_ris)
         polished = gen(
-            [HIERARCHICAL_POLISH.format(summary=f) for f in finals], owners=all_ris
+            [HIERARCHICAL_POLISH.format(summary=f) for f in finals], owners=all_ris,
+            cache_hints=[template_header(HIERARCHICAL_POLISH)] * len(finals),
         )
         for ri, p in enumerate(polished):
             results[ri].summary = p

@@ -11,7 +11,12 @@ from __future__ import annotations
 from ..backend.base import Backend
 from ..text.splitter import RecursiveTokenSplitter
 from .base import StrategyResult, _BatchCounter, register_strategy
-from .prompts import ITERATIVE_INITIAL, ITERATIVE_REFINE
+from .prompts import ITERATIVE_INITIAL, ITERATIVE_REFINE, template_header
+
+# the refine prompt up to (not including) {context}: header + the carried
+# existing_answer — a retried/replayed refine round re-prefills the whole
+# prior summary verbatim, so the cache_hint covers it, not just the header
+_REFINE_PREFIX = ITERATIVE_REFINE[: ITERATIVE_REFINE.find("{context}")]
 
 
 @register_strategy
@@ -56,6 +61,7 @@ class IterativeStrategy:
                 # speculation references (vnsum_tpu.spec): the seed summary
                 # extracts from its chunk
                 refs = [chunks_per_doc[di][0] for di in idx]
+                hints = [template_header(ITERATIVE_INITIAL)] * len(idx)
             else:
                 prompts = [
                     ITERATIVE_REFINE.format(
@@ -70,7 +76,13 @@ class IterativeStrategy:
                     summaries[di] + "\n\n" + chunks_per_doc[di][r]
                     for di in idx
                 ]
-            outs = gen(prompts, owners=idx, references=refs)
+                # the cacheable prefix of a refine prompt is the header PLUS
+                # the re-fed prior summary (everything before the new chunk)
+                hints = [
+                    _REFINE_PREFIX.format(existing_answer=summaries[di])
+                    for di in idx
+                ]
+            outs = gen(prompts, owners=idx, references=refs, cache_hints=hints)
             for di, out in zip(idx, outs):
                 summaries[di] = out
 

@@ -15,7 +15,7 @@ from ..backend.base import Backend
 from ..text.splitter import RecursiveTokenSplitter
 from ..text.tokenizer import whitespace_token_count
 from .base import StrategyResult, _BatchCounter, register_strategy, split_by_token_budget
-from .prompts import MAPREDUCE_MAP, MAPREDUCE_REDUCE
+from .prompts import MAPREDUCE_MAP, MAPREDUCE_REDUCE, template_header
 
 
 @register_strategy
@@ -71,7 +71,11 @@ class MapReduceStrategy:
         # map: every chunk of every document in one batch. The chunk text
         # rides along as the speculation reference — a map summary is
         # largely extractive, exactly the overlap the reference drafter
-        # (vnsum_tpu.spec) turns into accepted tokens
+        # (vnsum_tpu.spec) turns into accepted tokens — and the shared
+        # template header is the cache_hint: every map prompt of every
+        # document starts with it, so one prefilled header (vnsum_tpu.cache)
+        # serves the whole fan-out
+        map_hint = template_header(self.map_prompt)
         flat = [
             (di, self.map_prompt.format(content=c), c)
             for di, chunks in enumerate(chunks_per_doc)
@@ -81,6 +85,7 @@ class MapReduceStrategy:
             [p for _, p, _ in flat],
             owners=[di for di, _, _ in flat],
             references=[c for _, _, c in flat],
+            cache_hints=[map_hint] * len(flat),
         )
         summaries: list[list[str]] = [[] for _ in docs]
         for (di, _, _), out in zip(flat, outs):
@@ -132,7 +137,8 @@ class MapReduceStrategy:
             if not prompts:
                 break
             outs = gen(
-                prompts, owners=[di for _, di, _ in batch], references=refs
+                prompts, owners=[di for _, di, _ in batch], references=refs,
+                cache_hints=[template_header(self.reduce_prompt)] * len(prompts),
             )
             for di in over:
                 summaries[di] = [None] * len(grouped[di])  # type: ignore[list-item]

@@ -2,7 +2,21 @@
 the prompts ARE the product — preserved exactly, cited per template).
 
 Templates are plain ``str.format`` strings; no prompt-framework layer.
+
+:func:`template_header` extracts the literal text before a template's first
+placeholder — the cross-request cacheable prefix every strategy passes as
+its ``cache_hint`` (vnsum_tpu.cache): all map prompts of all documents share
+the header byte-for-byte, so one prefilled header serves the whole fan-out.
+Prefix-stability of the shipped headers under tokenization is pinned by
+tests/test_text_tokenizer.py (prefix caching is unsound without it).
 """
+
+
+def template_header(template: str) -> str:
+    """The literal prefix of ``template`` before its first ``{placeholder}``
+    — by construction a string prefix of every prompt formatted from it."""
+    i = template.find("{")
+    return template[:i] if i >= 0 else template
 
 # map prompt — runners/run_summarization_ollama_mapreduce.py:80-85
 MAPREDUCE_MAP = """Bạn là một chuyên gia tóm tắt nội dung.

@@ -7,7 +7,7 @@ from __future__ import annotations
 from ..backend.base import Backend
 from ..text.tokenizer import Tokenizer, get_tokenizer
 from .base import StrategyResult, _BatchCounter, register_strategy
-from .prompts import TRUNCATED
+from .prompts import TRUNCATED, template_header
 
 
 @register_strategy
@@ -47,8 +47,12 @@ class TruncatedStrategy:
         gen = _BatchCounter(backend or self.backend, self.max_new_tokens)
         truncated = [self._truncate(d) for d in docs]
         prompts = [TRUNCATED.format(text=t) for t in truncated]
-        # the truncated document is the speculation reference (vnsum_tpu.spec)
-        outs = gen(prompts, owners=list(range(len(docs))), references=truncated)
+        # the truncated document is the speculation reference (vnsum_tpu.spec);
+        # the shared template header is the prefix-cache hint
+        outs = gen(
+            prompts, owners=list(range(len(docs))), references=truncated,
+            cache_hints=[template_header(TRUNCATED)] * len(docs),
+        )
         return [
             StrategyResult(summary=o, num_chunks=1, llm_calls=1, rounds=1)
             for o in outs
