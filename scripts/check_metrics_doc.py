@@ -1,50 +1,40 @@
 #!/usr/bin/env python
-"""CI lint: every metric registered in serve/metrics.py must appear in the
-README's observability metrics table.
+"""CI lint shim: metrics registry <-> README table consistency.
 
-The registry keeps metric names as literal strings in `_reg("...")` calls
-exactly so this check can PARSE the source instead of importing it — the
-lint runs before dependencies are installed and can never be skewed by
-import-time failures. Fails (exit 1) listing any registered metric whose
-full `vnsum_serve_*` name is missing from README.md.
+The check now lives in the analysis framework as the `metrics-doc` rule
+(vnsum_tpu/analysis/rules/metrics_doc.py), which also extended it to be
+BIDIRECTIONAL: every registered metric must appear in the README, and every
+`vnsum_serve_*` name the README mentions must be a registered metric. This
+script stays as a thin entry point so CI step history remains comparable
+(and old muscle memory keeps working):
 
     python scripts/check_metrics_doc.py
+
+Equivalent to:
+
+    python -m vnsum_tpu.analysis --rule metrics-doc --root . vnsum_tpu/serve
+
+Like its predecessor it never imports the serving code — the rule parses
+source, so it runs before dependencies are installed.
 """
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
-METRICS_PY = ROOT / "vnsum_tpu" / "serve" / "metrics.py"
-README = ROOT / "README.md"
+sys.path.insert(0, str(ROOT))
 
-_REG = re.compile(r'_reg\(\s*"([a-z0-9_]+)"', re.MULTILINE)
-
-
-def registered_names() -> list[str]:
-    src = METRICS_PY.read_text(encoding="utf-8")
-    names = _REG.findall(src)
-    if not names:
-        raise SystemExit(
-            f"no _reg(\"...\") registrations found in {METRICS_PY} — "
-            "registry moved? update scripts/check_metrics_doc.py"
-        )
-    return [f"vnsum_serve_{n}" for n in names]
+from vnsum_tpu.analysis.core import render_findings, run_paths  # noqa: E402
 
 
 def main() -> int:
-    readme = README.read_text(encoding="utf-8")
-    missing = [n for n in registered_names() if n not in readme]
-    if missing:
-        print("metrics registered in serve/metrics.py but missing from the "
-              "README observability table:")
-        for n in missing:
-            print(f"  - {n}")
+    findings = run_paths([], root=ROOT, rules=["metrics-doc"])
+    if findings:
+        print(render_findings(findings))
         return 1
-    print(f"ok: all {len(registered_names())} registered metrics documented "
-          "in README.md")
+    print("ok: metrics registry and README observability table agree "
+          "(bidirectional)")
     return 0
 
 

@@ -1,4 +1,13 @@
 #!/usr/bin/env bash
-# Tier-1 verify — the ROADMAP.md command, verbatim. CI and local runs use
-# this wrapper so "what the driver checks" and "what you ran" cannot drift.
+# Tier-1 verify — static analysis gate, then the ROADMAP.md command verbatim.
+# CI and local runs use this wrapper so "what the driver checks" and "what
+# you ran" cannot drift.
+
+# named step: domain lint (guarded-by, host-sync-in-hot-path,
+# donation-safety, jit-recompile-hazard, metrics-doc). Exit 1 here means a
+# machine-checked invariant broke — fix it or lint-allow it with a reason.
+echo "== analysis: python -m vnsum_tpu.analysis vnsum_tpu/ scripts/ =="
+python -m vnsum_tpu.analysis vnsum_tpu/ scripts/ || exit 1
+
+# named step: the tier-1 fast suite (ROADMAP command, verbatim)
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

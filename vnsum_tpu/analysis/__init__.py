@@ -1,0 +1,40 @@
+"""Domain static analysis + runtime sanitizers for the serving stack.
+
+Four PRs in, correctness rests on hand-enforced invariants: lock discipline
+across the serve/cache/obs modules, no host<->device syncs inside the engine
+hot loops, donation safety on seeded-cache fns, and metrics/doc consistency.
+This package machine-checks them, the way production continuous-batching
+engines (Orca, OSDI '22) and SGLang's RadixAttention (arXiv:2312.07104 —
+whose cache design cache/radix.py mirrors) lean on sanitizers to keep
+scheduler/cache races out of serving:
+
+- :mod:`core`  — the AST lint framework: rule registry, per-file source
+  model (AST + comment map), ``# lint-allow[rule]: reason`` suppressions
+  (a reason is mandatory), human + JSON output, and the
+  ``python -m vnsum_tpu.analysis`` CLI (:mod:`__main__`);
+- :mod:`rules` — the domain rules: ``guarded-by`` (fields annotated
+  ``# guarded by: <lock>`` must only be touched under ``with self.<lock>``),
+  ``host-sync-in-hot-path`` (``.item()`` / ``device_get`` / ``np.asarray`` /
+  ``block_until_ready`` banned in functions marked ``# hot path``),
+  ``donation-safety`` (reusing a binding after passing it to a
+  ``donate_argnums`` position), ``jit-recompile-hazard`` (Python branching
+  on traced args, f-strings inside jitted fns), and ``metrics-doc`` (the
+  serve/metrics.py registry and the README observability table must match
+  bidirectionally — absorbs scripts/check_metrics_doc.py);
+- :mod:`sanitizers` — runtime detectors switchable via ``VNSUM_SANITIZERS``:
+  a lockdep-style lock-order detector wrapping the serve/cache/obs locks
+  (wait-for graph across threads, fails on cycles) and the
+  ``jax.transfer_guard`` hot-loop wiring that turns implicit device->host
+  transfers inside decode/prefill into errors. Both are constructed-away
+  when disabled: ``make_lock`` returns a plain ``threading.Lock`` and
+  ``hot_path_transfer_guard`` a ``nullcontext``, so production pays zero
+  extra acquisitions (tests/test_analysis_sanitizers.py pins that).
+
+Lint annotations are conventions, not syntax: ``# guarded by: <lock>[, alt]``
+on a ``self.field = ...`` line, ``# hot path`` on (or directly above) a
+``def`` line, and methods named ``*_locked`` are trusted to be called with
+the lock already held (the repo's existing naming convention).
+"""
+from .core import Finding, Rule, all_rules, run_paths
+
+__all__ = ["Finding", "Rule", "all_rules", "run_paths"]

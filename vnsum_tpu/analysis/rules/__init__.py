@@ -1,0 +1,4 @@
+"""The domain rule set. Importing this package registers every rule with
+:mod:`vnsum_tpu.analysis.core`; add a module here and import it below to
+ship a new rule."""
+from . import donation, guarded_by, host_sync, metrics_doc, recompile  # noqa: F401

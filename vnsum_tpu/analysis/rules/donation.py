@@ -1,0 +1,136 @@
+"""donation-safety: never reuse a binding after donating it to XLA.
+
+``jax.jit(f, donate_argnums=(i,))`` hands argument *i*'s buffer to the
+compiled program; the Python binding still points at it, but the array is
+deleted — reading it later raises (or worse, on some backends, reads
+garbage). The engine's segment/spec/seeded-cache programs all donate, and
+their callers must rebind from the call's results (the pattern
+``t, cur, cache, done, out = segment(..., cache, ..., out, ...)``).
+
+Scope: intra-function dataflow, deliberately conservative. The rule tracks
+``name = jax.jit(fn, donate_argnums=...)`` bindings and flags a *load* of a
+donated positional argument's name after the call, unless the call's own
+assignment (or a later store before the first load) rebinds it. Calls
+through attributes, dict caches, or other scopes (the engine's
+``_get_seg_fn`` indirection) are out of reach — for those the runtime check
+is XLA's own donated-buffer error, which the engine test suites exercise.
+Line-ordered, control-flow-insensitive: a fixture-honest approximation, not
+an alias analysis.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile, register
+
+
+def _donated_indices(call: ast.Call) -> list[int] | None:
+    """donate_argnums literal of a jax.jit(...) call, else None."""
+    f = call.func
+    is_jit = (
+        (isinstance(f, ast.Attribute) and f.attr == "jit")
+        or (isinstance(f, ast.Name) and f.id == "jit")
+    )
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    out.append(elt.value)
+            return out
+    return None
+
+
+def _assigned_names(stmt_targets: list[ast.expr]) -> set[str]:
+    names: set[str] = set()
+    for t in stmt_targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                names.add(node.id)
+    return names
+
+
+@register
+class DonationRule(Rule):
+    name = "donation-safety"
+    description = (
+        "a binding passed at a donate_argnums position must not be read "
+        "after the call unless the call's results rebind it"
+    )
+
+    def check(self, sf: SourceFile) -> list[Finding]:
+        # nested defs are walked by their enclosing scope too — dedupe so a
+        # closure-local violation reports once
+        seen: dict[Finding, None] = {}
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for f in self._check_scope(sf, fn):
+                seen.setdefault(f)
+        return list(seen)
+
+    def _check_scope(self, sf: SourceFile, fn: ast.AST) -> list[Finding]:
+        # jitted-with-donation bindings created in THIS scope
+        donating: dict[str, list[int]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                idx = _donated_indices(node.value)
+                if idx:
+                    for name in _assigned_names(node.targets):
+                        donating[name] = idx
+        if not donating:
+            return []
+
+        findings: list[Finding] = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                continue
+            idx = donating.get(node.func.id)
+            if not idx:
+                continue
+            rebound = _assigned_names(
+                getattr(getattr(node, "_lint_parent", None), "targets", [])
+            )
+            for i in idx:
+                if i >= len(node.args) or not isinstance(node.args[i], ast.Name):
+                    continue
+                donated = node.args[i].id
+                if donated in rebound:
+                    continue
+                findings.extend(self._reused_after(
+                    sf, fn, donated, node, node.func.id
+                ))
+        return findings
+
+    def _reused_after(self, sf, fn, name: str, call: ast.Call,
+                      fn_name: str) -> list[Finding]:
+        # "after the call" = after its LAST line: a multi-line call's own
+        # argument occurrences are part of the donation, not a reuse
+        call_line = call.end_lineno or call.lineno
+        loads = []
+        stores = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == name \
+                    and node.lineno > call_line:
+                if isinstance(node.ctx, ast.Load):
+                    loads.append(node.lineno)
+                else:
+                    stores.append(node.lineno)
+        if not loads:
+            return []
+        first_load = min(loads)
+        if stores and min(stores) <= first_load:
+            return []  # rebound before any read
+        return [Finding(
+            self.name, sf.path, first_load,
+            f"{name!r} is read after being donated to {fn_name}() at line "
+            f"{call.lineno} (donate_argnums) — its buffer no longer exists; "
+            "rebind from the call's results",
+        )]

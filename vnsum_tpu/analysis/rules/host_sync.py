@@ -1,0 +1,84 @@
+"""host-sync-in-hot-path: no host<->device syncs inside marked hot loops.
+
+A ``.item()``, ``np.asarray(device_array)``, ``jax.device_get`` or
+``block_until_ready`` inside the engine's decode/prefill loops forces the
+host to wait on the device — exactly the per-segment stall PERF.md's
+measurement-hygiene notes fight, and the silent way a refactor turns an
+async dispatch pipeline into lockstep. Functions whose ``def`` line (or the
+line directly above it) carries a ``# hot path`` comment are scanned; every
+sync-shaped call inside must either go away or carry a
+``# lint-allow[host-sync-in-hot-path]: <why this sync is load-bearing>``.
+
+The ban is textual, not semantic: ``np.asarray`` on a host list is no sync,
+but it reads identically to one in review — the suppression reason is where
+the difference gets written down. Intended fetches should be EXPLICIT
+``jax.device_get`` (suppressed with their reason): the runtime half of this
+check, ``sanitizers.hot_path_transfer_guard``, errors on *implicit*
+device->host transfers in sanitizer mode, so acknowledged syncs pass the
+guard and unacknowledged ones fail it.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Rule, SourceFile, register
+
+HOT_RE = re.compile(r"#\s*hot path\b")
+
+# attribute-call names that always read as a sync
+_ATTR_CALLS = {"item", "block_until_ready"}
+# (module alias, function) calls; bare names cover `from jax import device_get`
+_FN_CALLS = {
+    ("jax", "device_get"), ("np", "asarray"), ("numpy", "asarray"),
+}
+_BARE_CALLS = {"device_get"}
+
+
+def _is_hot(sf: SourceFile, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for line in (fn.lineno, fn.lineno - 1):
+        if HOT_RE.search(sf.comment(line)):
+            return True
+    return False
+
+
+def _sync_call(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _ATTR_CALLS:
+            return f".{f.attr}()"
+        if isinstance(f.value, ast.Name) and (f.value.id, f.attr) in _FN_CALLS:
+            return f"{f.value.id}.{f.attr}()"
+    elif isinstance(f, ast.Name) and f.id in _BARE_CALLS:
+        return f"{f.id}()"
+    return None
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync-in-hot-path"
+    description = (
+        ".item()/device_get/np.asarray/block_until_ready are banned inside "
+        "functions marked '# hot path'; intended syncs carry a reasoned "
+        "lint-allow"
+    )
+
+    def check(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _is_hot(sf, fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                what = _sync_call(node)
+                if what is not None:
+                    out.append(Finding(
+                        self.name, sf.path, node.lineno,
+                        f"{what} inside hot-path function {fn.name!r} — "
+                        "remove the sync or lint-allow it with the reason "
+                        "it is load-bearing",
+                    ))
+        return out

@@ -1,0 +1,144 @@
+"""jit-recompile-hazard: host-Python control flow on traced values.
+
+Inside a jitted function, a Python ``if``/``while``/``assert`` on a traced
+argument either raises ConcretizationTypeError at trace time or — when the
+argument is accidentally static — silently recompiles per distinct value
+(the per-K program fan-out backend/engine.py's resume path bounds with an
+explicit grid is the *managed* version of this hazard). F-strings inside a
+jitted body are the same trap in string form: interpolating a tracer
+concretizes it, and even constant ones run per trace.
+
+Detection: functions directly jitted in the SAME scope — decorated with
+``@jax.jit`` / ``@partial(jax.jit, ...)`` or passed as ``jax.jit(fn, ...)``.
+Parameters named in ``static_argnums``/``static_argnames`` literals are
+excluded (branching on statics is the point of statics). ``x is None`` /
+``x is not None`` tests are allowed — tracers are never None, so that is a
+host-level structure check, not a value branch.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile, register
+
+
+def _jit_call(call: ast.Call) -> bool:
+    f = call.func
+    return (
+        (isinstance(f, ast.Attribute) and f.attr == "jit")
+        or (isinstance(f, ast.Name) and f.id == "jit")
+    )
+
+
+def _static_params(call: ast.Call | None, fn: ast.FunctionDef) -> set[str]:
+    """Parameter names made static by static_argnums/static_argnames."""
+    if call is None:
+        return set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: set[str] = set()
+    for kw in call.keywords:
+        vals: list = []
+        v = kw.value
+        if isinstance(v, ast.Constant):
+            vals = [v.value]
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            vals = [e.value for e in v.elts if isinstance(e, ast.Constant)]
+        if kw.arg == "static_argnums":
+            static.update(params[i] for i in vals
+                          if isinstance(i, int) and i < len(params))
+        elif kw.arg == "static_argnames":
+            static.update(s for s in vals if isinstance(s, str))
+    return static
+
+
+def _jitted_functions(sf: SourceFile):
+    """Yield (fn_def, jit_call | None) for directly-jitted functions."""
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs[node.name] = node
+            for dec in node.decorator_list:
+                # @jax.jit / @jit
+                if (isinstance(dec, ast.Attribute) and dec.attr == "jit") or (
+                    isinstance(dec, ast.Name) and dec.id == "jit"
+                ):
+                    yield node, None
+                # @jax.jit(...) / @partial(jax.jit, ...)
+                elif isinstance(dec, ast.Call):
+                    if _jit_call(dec):
+                        yield node, dec
+                    elif (
+                        isinstance(dec.func, ast.Name)
+                        and dec.func.id == "partial"
+                        and dec.args
+                        and isinstance(dec.args[0], (ast.Attribute, ast.Name))
+                        and _jit_call(ast.Call(func=dec.args[0], args=[],
+                                               keywords=[]))
+                    ):
+                        yield node, dec
+        elif isinstance(node, ast.Call) and _jit_call(node) and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name) and target.id in defs:
+                yield defs[target.id], node
+
+
+def _is_none_test(test: ast.expr) -> bool:
+    return (
+        isinstance(test, ast.Compare)
+        and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+    )
+
+
+def _names_in(expr: ast.expr) -> set[str]:
+    return {
+        n.id for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+@register
+class RecompileRule(Rule):
+    name = "jit-recompile-hazard"
+    description = (
+        "Python if/while/assert on traced args and f-strings inside "
+        "jitted functions concretize tracers or fan out recompiles"
+    )
+
+    def check(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        seen: set[tuple[int, str]] = set()
+        for fn, jit_call in _jitted_functions(sf):
+            static = _static_params(jit_call, fn)
+            traced = {
+                a.arg for a in fn.args.posonlyargs + fn.args.args
+                + fn.args.kwonlyargs
+            } - static - {"self"}
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While, ast.Assert)):
+                    if _is_none_test(node.test):
+                        continue
+                    hit = _names_in(node.test) & traced
+                    if hit:
+                        kind = type(node).__name__.lower()
+                        key = (node.lineno, kind)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        out.append(Finding(
+                            self.name, sf.path, node.lineno,
+                            f"Python {kind} on traced arg(s) "
+                            f"{sorted(hit)} inside jitted {fn.name!r} — "
+                            "use lax.cond/where, or mark the arg static",
+                        ))
+                elif isinstance(node, ast.JoinedStr):
+                    key = (node.lineno, "fstring")
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(Finding(
+                        self.name, sf.path, node.lineno,
+                        f"f-string inside jitted {fn.name!r} — interpolating "
+                        "a tracer concretizes it; format on the host or use "
+                        "jax.debug.print",
+                    ))
+        return out

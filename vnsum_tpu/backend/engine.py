@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.sanitizers import hot_path_transfer_guard
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
 from .base import (
@@ -99,8 +100,9 @@ class EngineStats:
     by_bucket: dict = field(default_factory=dict)
     # host-phase wall clock (always on: the timers wrap pure-host work) plus,
     # under instrument=True, the device phases "prefill"/"decode" measured by
-    # result-fetch sync (np.asarray — block_until_ready is unreliable on the
-    # tunnel, PERF.md measurement hygiene)
+    # explicit result-fetch sync (jax.device_get — block_until_ready is
+    # unreliable on the tunnel, PERF.md measurement hygiene; every hot-path
+    # fetch is a lint-acknowledged device_get, see analysis/rules/host_sync)
     phase_seconds: dict = field(default_factory=dict)
     # instrument=True: one record per device dispatch {B, S, steps,
     # prefill_s, decode_s} — enough to reconstruct FLOP and HBM-byte budgets
@@ -696,6 +698,7 @@ class TpuBackend:
             )
         return jax.jit(choose)
 
+    # hot path
     def score_choices(
         self, prompts: list[str], choices: list[str]
     ) -> list[int]:
@@ -730,32 +733,38 @@ class TpuBackend:
 
         order = sorted(range(len(encoded)), key=lambda i: len(encoded[i]))
         results: list[int] = [0] * len(encoded)
-        for start in range(0, len(order), self.batch_size):
-            group = order[start : start + self.batch_size]
-            # max_new=0: choice scoring has no decode budget, so the whole
-            # context is prompt space; bucketing/padding rules are shared
-            # with generate() via _pack_group
-            tokens, pad_lens, B, S = self._pack_group(group, encoded, 0)
-            key = ("choice", B, S, len(ids))
-            if key not in self._fns:
-                t0 = time.time()
-                self._fns[key] = self._make_choice_fn(B, S, len(ids))
-                logger.info("built choice fn for bucket B=%d S=%d", B, S)
-                self.stats.compile_seconds += time.time() - t0
-            t_disp = time.time()
-            with annotate(f"choice[B={B},S={S}]"):
-                idx = self._fns[key](
-                    self.params, tokens, pad_lens, choice_dev
+        # sanitizer hook (analysis pkg): nullcontext in production; under
+        # VNSUM_SANITIZERS=transfer any IMPLICIT device->host transfer in
+        # this dispatch loop raises, while the lint-acknowledged explicit
+        # device_get fetches pass
+        with hot_path_transfer_guard():
+            for start in range(0, len(order), self.batch_size):
+                group = order[start : start + self.batch_size]
+                # max_new=0: choice scoring has no decode budget, so the
+                # whole context is prompt space; bucketing/padding rules
+                # are shared with generate() via _pack_group
+                tokens, pad_lens, B, S = self._pack_group(group, encoded, 0)
+                key = ("choice", B, S, len(ids))
+                if key not in self._fns:
+                    t0 = time.time()
+                    self._fns[key] = self._make_choice_fn(B, S, len(ids))
+                    logger.info("built choice fn for bucket B=%d S=%d", B, S)
+                    self.stats.compile_seconds += time.time() - t0
+                t_disp = time.time()
+                with annotate(f"choice[B={B},S={S}]"):
+                    idx = self._fns[key](
+                        self.params, tokens, pad_lens, choice_dev
+                    )
+                # lint-allow[host-sync-in-hot-path]: result fetch = the sync that makes the choice timing real
+                idx_h = jax.device_get(idx)
+                if self.instrument:
+                    self.stats.add_phase("choice", time.time() - t_disp)
+                self.stats.batches += 1
+                self.stats.by_bucket[(B, S)] = (
+                    self.stats.by_bucket.get((B, S), 0) + 1
                 )
-            idx_h = np.asarray(idx)  # fetch = sync, so the time is real
-            if self.instrument:
-                self.stats.add_phase("choice", time.time() - t_disp)
-            self.stats.batches += 1
-            self.stats.by_bucket[(B, S)] = (
-                self.stats.by_bucket.get((B, S), 0) + 1
-            )
-            for row, i in enumerate(group):
-                results[i] = int(idx_h[row])
+                for row, i in enumerate(group):
+                    results[i] = int(idx_h[row])
         return results
 
     # -- continuous scheduling programs ---------------------------------
@@ -836,6 +845,7 @@ class TpuBackend:
         self._dispatch += 1
         return s
 
+    # hot path
     def _run_group_continuous(
         self, group, encoded, max_new: int, gen, results, seed: int,
         packed=None, resume=None, insert_cb=None,
@@ -881,7 +891,8 @@ class TpuBackend:
             if self.instrument:
                 # fetch forces the dispatch to completion: [B] bools, the
                 # cheapest output — prefill device time is now bounded
-                np.asarray(done)
+                # lint-allow[host-sync-in-hot-path]: instrument=True exists to bound prefill with exactly this sync
+                jax.device_get(done)
         prefill_s = time.time() - t_pre
         # engine step telemetry (vnsum_tpu.obs): host timestamps around the
         # dispatched device call — no extra sync; without instrument=True the
@@ -915,14 +926,19 @@ class TpuBackend:
             t_seg = time.time()
             t_seg_m = time.monotonic() if tracing else 0.0
             segment = self._get_seg_fn("segment", B, S, max_new, gen)
+            # lint-allow[host-sync-in-hot-path]: host list -> host array for the uids argument, no device sync
+            uids_np = np.asarray(uid_of_slot, dtype=np.int32)
             with annotate(f"decode_seg[B={B},S={S}]"):
                 t, cur, cache, done, out = segment(
-                    self.params, t, cur, cache, done,
-                    np.asarray(uid_of_slot, dtype=np.int32), out, pad_dev,
+                    self.params, t, cur, cache, done, uids_np, out, pad_dev,
                     seed,
                 )
-            done_h = np.asarray(done)  # fetch = sync; segment time is real
-            t_h = int(t)
+            # ONE explicit fetch for both control values: done gates the
+            # harvest/compaction decision and t bounds the budget — this
+            # sync IS the segment boundary (and makes its timing real)
+            # lint-allow[host-sync-in-hot-path]: segment-boundary done/t fetch is the scheduler's control dependency
+            done_h, t_h = jax.device_get((done, t))
+            t_h = int(t_h)
             seg_s = time.time() - t_seg
             decode_s += seg_s
             # per-segment telemetry: the done fetch above already synced, so
@@ -950,7 +966,8 @@ class TpuBackend:
             ):
                 B_new //= 2
             if B_new < B:
-                out_h = np.asarray(out)
+                # lint-allow[host-sync-in-hot-path]: harvesting finished rows' tokens before their slots are compacted away
+                out_h = jax.device_get(out)
                 for r in live:
                     if done_h[r]:  # harvest leaving rows
                         results[rows[r]] = self._detok(out_h[r], tuple(gen.eos_ids))
@@ -981,7 +998,8 @@ class TpuBackend:
                 }
             )
 
-        out_h = np.asarray(out)
+        # lint-allow[host-sync-in-hot-path]: final result fetch — the generation is over, detok needs the tokens
+        out_h = jax.device_get(out)
         for r, orig in enumerate(rows):
             if orig is not None and results[orig] is None:
                 results[orig] = self._detok(out_h[r], tuple(gen.eos_ids))
@@ -1119,6 +1137,7 @@ class TpuBackend:
             self.stats.compile_seconds += time.time() - t0
         return self._fns[key]
 
+    # hot path
     def _run_group_spec(
         self, group, encoded, references, max_new: int, gen, results,
         report, seed: int,
@@ -1154,7 +1173,8 @@ class TpuBackend:
         with annotate(f"spec_prefill[B={B},S={S}]"):
             cur, cache, done = prefill(self.params, tokens, pads, seed)
         if self.instrument:
-            np.asarray(done)
+            # lint-allow[host-sync-in-hot-path]: instrument=True exists to bound prefill with exactly this sync
+            jax.device_get(done)
             self.stats.add_phase("prefill", time.time() - t_pre)
         if tracing:
             emit("spec_prefill", t_pre_m, time.time() - t_pre, B=B, S=S,
@@ -1172,7 +1192,8 @@ class TpuBackend:
         drafted = np.zeros((B,), dtype=np.int64)
         accepted = np.zeros((B,), dtype=np.int64)
         steps_live = np.zeros((B,), dtype=np.int64)
-        prev_done = np.asarray(done)
+        # lint-allow[host-sync-in-hot-path]: prefill done mask seeds the host loop's exit condition
+        prev_done = jax.device_get(done)
         t_dec = time.time()
         while not prev_done.all():
             t_step = time.monotonic() if tracing else 0.0
@@ -1182,11 +1203,14 @@ class TpuBackend:
                     ref_dev, lens_dev, seed,
                 )
             steps_live += ~prev_done
-            nd_h, acc_h = np.asarray(nd), np.asarray(acc)
+            # ONE explicit fetch per verify step: draft/accept counts feed
+            # the acceptance stats and done drives the loop exit — this is
+            # the sync the host loop already owes
+            # lint-allow[host-sync-in-hot-path]: per-step nd/acc/done fetch is the verify loop's control dependency
+            nd_h, acc_h, prev_done = jax.device_get((nd, acc, done))
             drafted += nd_h
             accepted += acc_h
             self.stats.spec_verify_steps += 1
-            prev_done = np.asarray(done)
             # per-verify-step telemetry: the nd/acc/done fetches above are
             # the sync the loop already paid — drafted vs accepted feeds the
             # rolling acceptance gauge's per-step ground truth. Gated: the
@@ -1200,7 +1224,8 @@ class TpuBackend:
         self.stats.spec_draft_tokens += int(drafted[: len(group)].sum())
         self.stats.spec_accepted_tokens += int(accepted[: len(group)].sum())
 
-        out_h = np.asarray(out)[:, :max_new]
+        # lint-allow[host-sync-in-hot-path]: final result fetch — detok needs the emitted tokens
+        out_h = jax.device_get(out)[:, :max_new]
         for row, i in enumerate(group):
             results[i] = self._detok(out_h[row], tuple(gen.eos_ids))
             report[i] = SpecRecord(
@@ -1383,6 +1408,7 @@ class TpuBackend:
         self.stats.add_phase("pack_host", time.time() - t_pack)
         return tokens, pad_lens, B, S
 
+    # hot path
     def generate(
         self,
         prompts: list[str],
@@ -1501,80 +1527,86 @@ class TpuBackend:
             self.instrument or max_new > self.segment_tokens
         )
         try:
-            for start in range(0, len(order), self.batch_size):
-                group = order[start : start + self.batch_size]
-                seed = self._next_seed(gen)
-                # per-GROUP spec routing: a coalesced batch can mix
-                # referenced and reference-less requests, and length-sorting
-                # may put all the refless ones in one group — that group
-                # would pay the (k+1)-wide verify forward to retire one
-                # token per step, so it takes the plain path instead
-                # (identical greedy output either way; its spec_report rows
-                # stay zero)
-                if spec_on and any(references[i] for i in group):
-                    self._run_group_spec(
-                        group, encoded, references, max_new, gen, results,
-                        spec_report, seed,
+            # sanitizer hook (analysis pkg): nullcontext in production;
+            # under VNSUM_SANITIZERS=transfer any IMPLICIT device->host
+            # transfer inside the dispatch loop raises, while the
+            # lint-acknowledged explicit device_get fetches pass
+            with hot_path_transfer_guard():
+                for start in range(0, len(order), self.batch_size):
+                    group = order[start : start + self.batch_size]
+                    seed = self._next_seed(gen)
+                    # per-GROUP spec routing: a coalesced batch can mix
+                    # referenced and reference-less requests, and length-sorting
+                    # may put all the refless ones in one group — that group
+                    # would pay the (k+1)-wide verify forward to retire one
+                    # token per step, so it takes the plain path instead
+                    # (identical greedy output either way; its spec_report rows
+                    # stay zero)
+                    if spec_on and any(references[i] for i in group):
+                        self._run_group_spec(
+                            group, encoded, references, max_new, gen, results,
+                            spec_report, seed,
+                        )
+                        continue
+                    tokens, pad_lens, B, S = self._pack_group(
+                        group, encoded, max_new
                     )
-                    continue
-                tokens, pad_lens, B, S = self._pack_group(
-                    group, encoded, max_new
-                )
-                resume = None
-                if matches is not None:
-                    resume = self._prepare_resume(
-                        group, encoded, matches, pad_lens, B, S, max_new,
-                        tracing,
-                    )
-                if resume is not None:
-                    for row, i in enumerate(group):
-                        cache_report[i] = resume[2][row]
-                insert_cb = None
-                if use_cache:
-                    def insert_cb(cache, _g=group, _p=pad_lens):
-                        self._cache_insert(
-                            cache, _g, encoded, matches, cache_hints, _p,
+                    resume = None
+                    if matches is not None:
+                        resume = self._prepare_resume(
+                            group, encoded, matches, pad_lens, B, S, max_new,
                             tracing,
                         )
-                if continuous:
-                    self._run_group_continuous(
-                        group, encoded, max_new, gen, results, seed,
-                        packed=(tokens, pad_lens, B, S),
-                        resume=resume and resume[:2], insert_cb=insert_cb,
+                    if resume is not None:
+                        for row, i in enumerate(group):
+                            cache_report[i] = resume[2][row]
+                    insert_cb = None
+                    if use_cache:
+                        def insert_cb(cache, _g=group, _p=pad_lens):
+                            self._cache_insert(
+                                cache, _g, encoded, matches, cache_hints, _p,
+                                tracing,
+                            )
+                    if continuous:
+                        self._run_group_continuous(
+                            group, encoded, max_new, gen, results, seed,
+                            packed=(tokens, pad_lens, B, S),
+                            resume=resume and resume[:2], insert_cb=insert_cb,
+                        )
+                        continue
+                    K = resume[0] if resume else 0
+                    fn = self._get_fn(B, S, max_new, gen, resume_from=K)
+                    t_disp = time.monotonic() if tracing else 0.0
+                    with annotate(f"generate[B={B},S={S}]"):
+                        if K:
+                            res = fn(self.params, tokens, pad_lens, seed,
+                                     resume[1])
+                        else:
+                            res = fn(self.params, tokens, pad_lens, seed)
+                        # with the prefix cache on, the program also returns its
+                        # final cache so new prefix blocks can be pooled
+                        out_dev, final_cache = res if pc is not None else (res, None)
+                        # lint-allow[host-sync-in-hot-path]: one-shot result fetch bounds the dispatch and feeds detok
+                        out = jax.device_get(out_dev)
+                    # the fused prefill+decode program has no observable
+                    # midpoint: one "dispatch" event bounds the whole device
+                    # call (the result fetch above synced it) — TTFT consumers
+                    # treat its end as the first-token upper bound
+                    if tracing:
+                        emit("dispatch", t_disp, time.monotonic() - t_disp,
+                             B=B, S=S, occupancy=len(group), max_new=max_new)
+                    self.stats.batches += 1
+                    self.stats.by_bucket[(B, S)] = (
+                        self.stats.by_bucket.get((B, S), 0) + 1
                     )
-                    continue
-                K = resume[0] if resume else 0
-                fn = self._get_fn(B, S, max_new, gen, resume_from=K)
-                t_disp = time.monotonic() if tracing else 0.0
-                with annotate(f"generate[B={B},S={S}]"):
-                    if K:
-                        res = fn(self.params, tokens, pad_lens, seed,
-                                 resume[1])
-                    else:
-                        res = fn(self.params, tokens, pad_lens, seed)
-                    # with the prefix cache on, the program also returns its
-                    # final cache so new prefix blocks can be pooled
-                    out_dev, final_cache = res if pc is not None else (res, None)
-                    out = np.asarray(out_dev)
-                # the fused prefill+decode program has no observable
-                # midpoint: one "dispatch" event bounds the whole device
-                # call (the result fetch above synced it) — TTFT consumers
-                # treat its end as the first-token upper bound
-                if tracing:
-                    emit("dispatch", t_disp, time.monotonic() - t_disp,
-                         B=B, S=S, occupancy=len(group), max_new=max_new)
-                self.stats.batches += 1
-                self.stats.by_bucket[(B, S)] = (
-                    self.stats.by_bucket.get((B, S), 0) + 1
-                )
-                if insert_cb is not None:
-                    insert_cb(final_cache)
-                t_detok = time.monotonic() if tracing else 0.0
-                for row, i in enumerate(group):
-                    results[i] = self._detok(out[row], tuple(gen.eos_ids))
-                if tracing:
-                    emit("detokenize", t_detok, time.monotonic() - t_detok,
-                         rows=len(group))
+                    if insert_cb is not None:
+                        insert_cb(final_cache)
+                    t_detok = time.monotonic() if tracing else 0.0
+                    for row, i in enumerate(group):
+                        results[i] = self._detok(out[row], tuple(gen.eos_ids))
+                    if tracing:
+                        emit("detokenize", t_detok, time.monotonic() - t_detok,
+                             rows=len(group))
         finally:
             if matches is not None:
                 for m in matches:

@@ -25,9 +25,10 @@ whitespace words for FakeBackend's synthetic mirror.
 """
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 from typing import Hashable, Sequence
+
+from ..analysis.sanitizers import make_lock
 
 
 @dataclass
@@ -78,16 +79,18 @@ class RadixIndex:
             raise ValueError("block_tokens must be >= 1")
         self.num_blocks = num_blocks
         self.block_tokens = block_tokens
-        self.stats = CacheStats()
-        self._root = _Node((), -1, None)
-        self._free: list[int] = list(range(num_blocks - 1, -1, -1))  # pop() -> 0 first
+        self.stats = CacheStats()               # guarded by: _lock
+        self._root = _Node((), -1, None)        # guarded by: _lock
+        # pop() -> 0 first
+        self._free: list[int] = list(range(num_blocks - 1, -1, -1))  # guarded by: _lock
         # LRU queue of evictable nodes (linked leaves with refcount 0), kept
         # in insertion order: refreshing moves a node to the MRU end, so
         # eviction is an O(1) front pop instead of a full-trie scan under
         # the lock (which would serialize HTTP-thread probes behind
         # O(nodes) insert churn at pool saturation)
-        self._evictable: dict[_Node, None] = {}
-        self._lock = threading.Lock()
+        self._evictable: dict[_Node, None] = {}  # guarded by: _lock
+        # lock-order-sanitizer hook: plain threading.Lock in production
+        self._lock = make_lock("cache.radix")
 
     # -- introspection ---------------------------------------------------
 
@@ -105,7 +108,7 @@ class RadixIndex:
 
     # -- matching --------------------------------------------------------
 
-    def _walk(self, tokens: Sequence[Hashable], max_tokens: int) -> list[_Node]:
+    def _walk_locked(self, tokens: Sequence[Hashable], max_tokens: int) -> list[_Node]:
         BLK = self.block_tokens
         limit = min(len(tokens), max_tokens)
         chain: list[_Node] = []
@@ -131,7 +134,7 @@ class RadixIndex:
         if max_tokens is None:
             max_tokens = len(tokens)
         with self._lock:
-            chain = self._walk(tokens, max_tokens)
+            chain = self._walk_locked(tokens, max_tokens)
             for n in chain:
                 n.refs += 1
                 self._evictable.pop(n, None)  # pinned: off the LRU queue
@@ -149,7 +152,7 @@ class RadixIndex:
         if max_tokens is None:
             max_tokens = len(tokens)
         with self._lock:
-            return len(self._walk(tokens, max_tokens)) * self.block_tokens
+            return len(self._walk_locked(tokens, max_tokens)) * self.block_tokens
 
     def release(self, match: Match) -> None:
         with self._lock:

@@ -33,6 +33,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..analysis.sanitizers import make_lock
+
 
 @dataclass
 class Span:
@@ -58,8 +60,9 @@ class SpanRecorder:
     def __init__(self, maxlen: int = 4096, on_close=None) -> None:
         self.maxlen = maxlen
         self.on_close = on_close
-        self._spans: list[Span] = []
-        self._lock = threading.Lock()
+        self._spans: list[Span] = []            # guarded by: _lock
+        # lock-order-sanitizer hook: plain threading.Lock in production
+        self._lock = make_lock("obs.spans")
         self._local = threading.local()
 
     def _stack(self) -> list[str]:
@@ -120,10 +123,11 @@ class RequestTrace:
         RequestTrace.allocations += 1
         self.trace_id = trace_id
         self.t_start = time.monotonic()
-        self.status = "open"
-        self.spans: list[Span] = []
-        self._lock = threading.Lock()
-        self._tracks = 0
+        self.status = "open"                    # guarded by: _lock
+        self.spans: list[Span] = []             # guarded by: _lock
+        # lock-order-sanitizer hook: plain threading.Lock in production
+        self._lock = make_lock("obs.trace")
+        self._tracks = 0                        # guarded by: _lock
 
     def next_track(self) -> int:
         with self._lock:
@@ -264,15 +268,16 @@ class ObsHub:
     def __init__(self, sample: float = 1.0, ring: int = 256) -> None:
         self.sample = max(0.0, min(float(sample), 1.0))
         self.ring = max(int(ring), 1)
-        self._lock = threading.Lock()
+        # lock-order-sanitizer hook: plain threading.Lock in production
+        self._lock = make_lock("obs.hub")
         # error-diffusion start point: the FIRST request is always sampled
         # (the next += sample crosses 1.0 immediately) and the long-run
         # traced fraction is exactly `sample`
-        self._acc = 1.0 - self.sample
-        self._requests: list[RequestTrace] = []
-        self._batches: list[BatchTrace] = []
-        self._batch_seq = 0
-        self.dropped_requests = 0
+        self._acc = 1.0 - self.sample           # guarded by: _lock
+        self._requests: list[RequestTrace] = []  # guarded by: _lock
+        self._batches: list[BatchTrace] = []    # guarded by: _lock
+        self._batch_seq = 0                     # guarded by: _lock
+        self.dropped_requests = 0               # guarded by: _lock
 
     # -- request side ----------------------------------------------------
 

@@ -28,8 +28,7 @@ scrape time by `serve/server.py`.
 """
 from __future__ import annotations
 
-import threading
-
+from ..analysis.sanitizers import make_lock
 from ..core.results import ServeRequestRecord, ServingStats
 from ..obs.histogram import (
     ACCEPT_BUCKETS,
@@ -117,17 +116,18 @@ class ServeMetrics:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._stats = ServingStats()
-        self._hists = {
+        # lock-order-sanitizer hook: plain threading.Lock in production
+        self._lock = make_lock("serve.metrics")
+        self._stats = ServingStats()            # guarded by: _lock
+        self._hists = {                         # guarded by: _lock
             "queue_wait_seconds": Histogram(WAIT_BUCKETS_S),
             "ttft_seconds": Histogram(TTFT_BUCKETS_S),
             "e2e_seconds": Histogram(E2E_BUCKETS_S),
             "batch_occupancy": Histogram(OCCUPANCY_BUCKETS),
             "spec_accepted_per_step": Histogram(ACCEPT_BUCKETS),
         }
-        self._rolling_accept = Rolling(256)
-        self._rolling_tps = Rolling(256)
+        self._rolling_accept = Rolling(256)     # guarded by: _lock
+        self._rolling_tps = Rolling(256)        # guarded by: _lock
 
     # -- observation hooks ----------------------------------------------
 

@@ -34,6 +34,7 @@ import contextlib
 import threading
 import time
 
+from ..analysis.sanitizers import make_lock
 from ..backend.base import Backend
 from ..core.config import GenerationConfig
 from ..core.logging import get_logger
@@ -441,8 +442,9 @@ class QueuedBackend:
         # as one process with its map/collapse fan-out side by side
         self.trace = trace
         self.trace_id = trace_id
-        self.records: list[ServeRequestRecord] = []
-        self._lock = threading.Lock()
+        self.records: list[ServeRequestRecord] = []  # guarded by: _lock
+        # lock-order-sanitizer hook: plain threading.Lock in production
+        self._lock = make_lock("serve.queued_backend")
 
     def generate(
         self,
