@@ -136,11 +136,18 @@ def main() -> int:
     if out_p.exists():
         # partial rerun (e.g. refreshing only the mapreduce arm after an
         # engine-default change): keep previously measured approaches,
-        # tagged with the config they ran under
-        prev = json.loads(out_p.read_text()).get("approaches", {})
+        # tagged with the config they ran under — and carry the mapreduce
+        # run HISTORY and best_measured through too, so a rerun that skips
+        # mapreduce doesn't silently drop the evidence behind the headline
+        prev_all = json.loads(out_p.read_text())
+        prev = prev_all.get("approaches", {})
         for k, v in prev.items():
             if k not in approaches:
                 per_approach[k] = v
+        if prev_all.get("mapreduce_run_history"):
+            rec["mapreduce_run_history"] = prev_all["mapreduce_run_history"]
+        if prev_all.get("best_measured"):
+            rec["best_measured"] = prev_all["best_measured"]
     for approach in approaches:
         full_eval = approach == "mapreduce"  # the headline gets the full
         # eval chain; the other four run their summarize phase (VERDICT
@@ -174,6 +181,13 @@ def main() -> int:
         )
         runner = PipelineRunner(cfg, backend_factory=lambda model: backend)
         compile_before = backend.stats.compile_seconds
+        # snapshot the engine counters so this approach's engine_stats are
+        # DELTAS: one shared backend serves every approach, and cumulative
+        # by_bucket/phase_seconds previously contaminated each row with all
+        # the approaches (and the EOS probe) that ran before it
+        bucket_before = dict(backend.stats.by_bucket)
+        phase_before = dict(backend.stats.phase_seconds)
+        generate_before = backend.stats.generate_seconds
         t0 = time.time()
         if full_eval:
             results = runner.run()
@@ -219,14 +233,22 @@ def main() -> int:
             backend.stats.compile_seconds - compile_before, 1
         )
         # engine-level attribution: bucket mix + host/device phase seconds
-        # (who ate the wall — dispatches, tokenize, or strategy host code)
+        # (who ate the wall — dispatches, tokenize, or strategy host code),
+        # as per-approach DELTAS against the snapshot above
         st = backend.stats
         row["engine_stats"] = {
-            "by_bucket": {f"B{b}xS{s}": n for (b, s), n in
-                          sorted(st.by_bucket.items())},
-            "phase_seconds": {k: round(v, 1) for k, v in
-                              sorted(st.phase_seconds.items())},
-            "generate_seconds": round(st.generate_seconds, 1),
+            "by_bucket": {
+                f"B{b}xS{s}": n - bucket_before.get((b, s), 0)
+                for (b, s), n in sorted(st.by_bucket.items())
+                if n - bucket_before.get((b, s), 0)
+            },
+            "phase_seconds": {
+                k: round(v - phase_before.get(k, 0.0), 1)
+                for k, v in sorted(st.phase_seconds.items())
+            },
+            "generate_seconds": round(
+                st.generate_seconds - generate_before, 1
+            ),
         }
         if row["docs_ok"] == 0:
             raise RuntimeError(f"{approach}: all documents failed")
@@ -237,13 +259,9 @@ def main() -> int:
             # code/data has measured 13.5-19.2 s), so single runs are
             # samples — keep them all, headline reports the latest and
             # best_measured the minimum
+            # prior runs' entries were carried into rec by the resume block
+            # up top, so a fresh measurement only ever APPENDS
             hist = rec.setdefault("mapreduce_run_history", [])
-            if out_p.exists():
-                prev_hist = json.loads(out_p.read_text()).get(
-                    "mapreduce_run_history", [])
-                for h in prev_hist:
-                    if h not in hist:
-                        hist.append(h)
             hist.append({
                 "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
                 "wall_minutes": row["wall_minutes"],

@@ -219,3 +219,34 @@ def test_flash_bf16_compute_dtype_close_to_f32():
             np.asarray(bf, np.float32)[b, int(pad[b]):],
             rtol=0.05, atol=0.05,
         )
+
+
+def test_vmem_guard_shrinks_bq_for_wide_groups():
+    """G=16 bottoms the bk guard at 512; the continuation must shrink bq
+    (not compile-OOM) and the interpreted kernel still matches dense."""
+    L, B, S, C, H, KV, hd = 1, 1, 16, 16, 16, 1, 128
+    q, cache = make_case(L, B, S, C, H, KV, hd, seed=5)
+    pad = jnp.zeros((B,), jnp.int32)
+    mask = prefill_attention_mask(pad, S, C)
+    dense = _attention(q, cache["k"][0], cache["v"][0], mask, H // KV)
+    flash = flash_prefill_attention(
+        q, cache, 0, pad, H // KV, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_vmem_guard_rejects_explicit_overrides_with_geometry():
+    """An explicit block_q that exceeds the scoped-VMEM ceiling must raise
+    a ValueError naming the geometry instead of a Mosaic compile OOM."""
+    L, B, S, C, H, KV, hd = 1, 1, 4096, 4096, 16, 1, 128
+    q = jnp.zeros((B, S, H, hd), jnp.float32)
+    cache = {
+        "k": jnp.zeros((L, B, KV, C, hd), jnp.float32),
+        "v": jnp.zeros((L, B, KV, C, hd), jnp.float32),
+    }
+    with pytest.raises(ValueError, match="scoped-VMEM.*G=16"):
+        flash_prefill_attention(
+            q, cache, 0, jnp.zeros((B,), jnp.int32), H // KV,
+            block_q=512, block_k=2048,
+        )

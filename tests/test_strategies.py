@@ -251,3 +251,30 @@ def test_mapreduce_finals_merge_into_collapse_rounds():
     ).summarize(small)
     assert results[0].summary == alone_big.summary
     assert results[1].summary == alone_small.summary
+
+
+def test_from_config_accepts_backend_without_batch_token_counting():
+    """Duck-typed backends that only implement count_tokens must still
+    construct (and split) via from_config — the splitter falls back to its
+    scalar length path (ADVICE round 5)."""
+
+    class ScalarOnlyBackend:
+        name = "scalar-only"
+
+        def __init__(self):
+            self._fake = FakeBackend()
+
+        def count_tokens(self, text):
+            return whitespace_token_count(text)
+
+        def generate(self, prompts, **kw):
+            return self._fake.generate(prompts, **kw)
+
+    cfg = PipelineConfig(chunk_size=60, chunk_overlap=0, token_max=120,
+                         iterative_chunk_size=60, iterative_chunk_overlap=0)
+    backend = ScalarOnlyBackend()
+    for cls in (MapReduceStrategy, IterativeStrategy):
+        strat = cls.from_config(backend, cfg)
+        res = strat.summarize(make_doc(n_paras=6, words_per=30))
+        assert res.summary
+        assert res.num_chunks >= 2

@@ -826,6 +826,200 @@ class TpuBackend:
         # the buffers can't be reused (donating only triggers warnings)
         return jax.jit(compact)
 
+    # -- in-flight slot serving programs (backend/inflight.py) -----------
+
+    def _make_slot_prefill_fn(self, B: int, S: int, max_new: int, gen,
+                              resume_from: int = 0):
+        """Prefill for a JOIN group of the in-flight slot loop: the same
+        forward as _make_parts' prefill_part (shared _prefill_forward, so
+        chunked and resume prefill ride along), but the first-token sampling
+        keys fold per-REQUEST uids passed in rather than the row's position
+        in the join batch — a request's sampled stream must not depend on
+        when it joined or who it joined with."""
+        C = S + max_new
+        _eos, vocab_limit, restrict = self._sampling_setup(gen)
+        use_flash, _ = self._decode_settings(S, C)
+        layer_window = self._layer_window_fn()
+
+        def slot_prefill(params, tokens, pad_lens, seed, uids, cache=None):
+            logits, cache = self._prefill_forward(
+                params, tokens, pad_lens, B, S, C, use_flash, layer_window,
+                cache=cache, start=resume_from,
+            )
+            base = jax.random.key(seed)
+            keys0 = jax.vmap(
+                lambda u: jax.random.fold_in(jax.random.fold_in(base, u), 0)
+            )(uids)
+            first = sample_logits_rows(
+                restrict(logits[:, -1, :vocab_limit]), keys0,
+                gen.temperature, gen.top_k, gen.top_p,
+            )
+            # all-pad filler rows (join-batch bucketing) start done
+            done0 = pad_lens == S
+            return first, cache, done0
+
+        if resume_from:
+            # the prefix-cache-seeded cache is consumed — donate its buffer
+            return jax.jit(slot_prefill, donate_argnums=(5,))
+        return jax.jit(slot_prefill)
+
+    def _make_slot_segment_fn(self, B: int, S: int, max_new: int, gen):
+        """One in-flight decode segment: advance every live slot by up to
+        ``segment_tokens`` tokens with PER-ROW step counters — the refill
+        path's defining requirement is that slots at different generation
+        depths decode together, so the shared scalar ``t`` of decode_part
+        becomes a [B] vector and masks/positions/cache-write slots ride the
+        spec-verify machinery (verify_attention_mask + vector write_index,
+        num_q=1). For any single row the emitted-token math is exactly
+        decode_part's, so greedy outputs match the one-shot path with the
+        same caveat class as compaction (batch-shape tiling last bits)."""
+        cfg = self.cfg
+        C = S + max_new
+        eos, vocab_limit, restrict = self._sampling_setup(gen)
+        _, use_flash_decode = self._decode_settings(S, C)
+        # the per-row-fills kernel is single-chip, like the spec verify path
+        use_kernel = use_flash_decode and self.mesh is None
+        interpret = self.interpret
+        layer_window = self._layer_window_fn()
+        seg = self.segment_tokens
+
+        def segment(params, t, cur, cache, done, uids, out, pads, seed):
+            base = jax.random.key(seed)
+
+            def emit_row(o, c, tt, d):
+                # done rows hold a frozen cursor: an unguarded write would
+                # clobber the row's last real token with its stale cur
+                upd = jax.lax.dynamic_update_slice(o, c[None], (tt,))
+                return jnp.where(d, o, upd)
+
+            def cond(carry):
+                k, _t, _cur, _cache, done, _out = carry
+                return (k < seg) & ~jnp.all(done)
+
+            def body(carry):
+                k, t, cur, cache, done, out = carry
+                # emit BEFORE sampling, mirroring decode_part: on exit every
+                # live token is written and the rest stay pad from the init
+                out = jax.vmap(emit_row)(out, cur, t, done)
+                done = done | jnp.isin(cur, eos)
+                fills = S + t                                   # [B]
+                positions = verify_positions(pads, fills, 1)
+                mask = verify_attention_mask(pads, fills, 1, C)
+                stacked_fn = None
+                if use_kernel:
+                    from ..ops.decode_attention import (
+                        flash_spec_verify_attention,
+                    )
+
+                    def stacked_fn(q, cache_d, layer_idx):
+                        return flash_spec_verify_attention(
+                            q, cache_d, layer_idx, pads, fills,
+                            cfg.q_per_kv, layer_window(layer_idx),
+                            interpret=interpret,
+                        )
+
+                logits, cache = forward(
+                    params, cfg, cur[:, None], positions, cache, fills,
+                    mask, stacked_attention_fn=stacked_fn,
+                )
+                step_keys = jax.vmap(
+                    lambda u, tt: jax.random.fold_in(
+                        jax.random.fold_in(base, u), tt + 1
+                    )
+                )(uids, t)
+                nxt = sample_logits_rows(
+                    restrict(logits[:, -1, :vocab_limit]), step_keys,
+                    gen.temperature, gen.top_k, gen.top_p,
+                )
+                # done rows freeze t (their out cursor) and cur; live rows
+                # advance exactly like decode_part's shared t
+                t = jnp.where(done, t, t + 1)
+                done = done | (t >= max_new)
+                cur = jnp.where(done, cur, nxt)
+                return (k + 1, t, cur, cache, done, out)
+
+            _, t, cur, cache, done, out = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), t, cur, cache, done, out)
+            )
+            return t, cur, cache, done, out
+
+        # donate the resident cache and out buffers: segments overwrite
+        # them in place, exactly like the continuous path's segment fn
+        return jax.jit(segment, donate_argnums=(3, 6))
+
+    def _make_adopt_fn(self, Bj: int):
+        """Refill program: scatter a join group's freshly prefilled cache
+        rows and per-row state into the resident slot batch at the target
+        slot indices — one advanced-index scatter per cache leaf, the same
+        per-row dynamic_update_slice-class machinery the prefix-cache store
+        uses for gathers. ``slot_idx`` entries are DISTINCT free slots by
+        construction (the loop caps the join bucket at the free-slot
+        count), so scatter ordering never matters."""
+        pad_id = self.tok.pad_id
+
+        def adopt(cache, cur, done, t, out, pads,
+                  join_cache, first, done0, join_pads, slot_idx):
+            cache = {
+                k: v.at[:, slot_idx].set(join_cache[k])
+                for k, v in cache.items()
+            }
+            cur = cur.at[slot_idx].set(first)
+            done = done.at[slot_idx].set(done0)
+            t = t.at[slot_idx].set(0)
+            out = out.at[slot_idx].set(pad_id)
+            pads = pads.at[slot_idx].set(join_pads)
+            return cache, cur, done, t, out, pads
+
+        # donate the resident cache/out (overwritten in place); the join
+        # cache is NOT donated — the scatter reads it into differently
+        # shaped outputs, so donation would only trigger warnings
+        return jax.jit(adopt, donate_argnums=(0, 4))
+
+    def start_slot_loop(
+        self,
+        slots: int | None = None,
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+        prompt_tokens: int = 0,
+    ):
+        """Open a persistent in-flight serving loop: a fixed-shape decode
+        batch of ``slots`` rows where finished rows are harvested at every
+        segment boundary and freed slots are REFILLED from new prompts
+        (chunked prefill + adopt-scatter into the resident cache) instead of
+        only compacted — Orca-style iteration-level scheduling over the
+        segmented-decode machinery. Single-chip for now, like the prefix
+        cache and the spec verify kernel. ``prompt_tokens`` fixes the
+        prompt bucket S (0 = the full context minus the decode budget);
+        prompts that don't fit are rejected at admit for the caller to
+        route through the one-shot path, which remains generate()'s
+        default."""
+        from .inflight import TpuSlotLoop
+
+        if self.mesh is not None:
+            raise ValueError(
+                "the in-flight slot loop is single-chip for now; "
+                "start_slot_loop requires mesh=None"
+            )
+        gen = config or self.gen_cfg
+        max_new = resolve_max_new(max_new_tokens, gen, self.max_new_tokens)
+        if max_new >= self.cfg.max_seq_len:
+            raise ValueError(
+                f"max_new_tokens={max_new} must be < "
+                f"max_seq_len={self.cfg.max_seq_len}"
+            )
+        max_input = self.cfg.max_seq_len - max_new
+        S = prompt_tokens or _bucket_len(max_input, max_input)
+        if S > max_input:
+            raise ValueError(
+                f"prompt_tokens={S} exceeds the context budget "
+                f"{max_input} (max_seq_len - max_new_tokens)"
+            )
+        return TpuSlotLoop(
+            self, slots or self.batch_size, S, max_new, gen,
+            seed=self._next_seed(gen),
+        )
+
     def _get_seg_fn(self, kind: str, B: int, S: int, max_new: int, gen,
                     resume_from: int = 0):
         key = (kind, B, S, max_new, gen.with_(seed=0), resume_from)
@@ -833,6 +1027,12 @@ class TpuBackend:
             t0 = time.time()
             if kind == "prefill":
                 fn = self._make_prefill_fn(B, S, max_new, gen, resume_from)
+            elif kind == "slot_prefill":
+                fn = self._make_slot_prefill_fn(B, S, max_new, gen, resume_from)
+            elif kind == "slot_seg":
+                fn = self._make_slot_segment_fn(B, S, max_new, gen)
+            elif kind == "adopt":
+                fn = self._make_adopt_fn(B)
             else:
                 fn = self._make_segment_fn(B, S, max_new, gen)
             self._seg_fns[key] = fn

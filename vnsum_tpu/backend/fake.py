@@ -64,6 +64,10 @@ class FakeBackend:
         spec_acceptance: float = 0.5,
         prefix_cache_blocks: int = 0,
         cache_block_tokens: int = 8,
+        segment_words: int = 8,
+        segment_overhead_s: float = 0.0,
+        per_slot_segment_s: float = 0.0,
+        per_step_s: float = 0.0,
     ) -> None:
         self._responses = list(responses) if responses else None
         self.summary_words = summary_words
@@ -87,6 +91,19 @@ class FakeBackend:
             self.prefix_index = RadixIndex(
                 prefix_cache_blocks, cache_block_tokens
             )
+        # in-flight slot loop latency model (start_slot_loop): each decode
+        # segment advances live rows by ``segment_words`` words and sleeps
+        # segment_overhead_s + per_slot_segment_s * live — the per-segment
+        # analogue of the one-shot batch_overhead_s/per_prompt_s model
+        self.segment_words = max(int(segment_words), 1)
+        self.segment_overhead_s = segment_overhead_s
+        self.per_slot_segment_s = per_slot_segment_s
+        # per-DECODE-STEP cost, charged by BOTH paths: a one-shot batch
+        # decodes until its LONGEST row finishes (per_step_s * max output
+        # words — the ragged-tail convoy a real fixed batch pays), while the
+        # slot loop pays only for the steps a segment actually runs. This
+        # is the economics in-flight refill exploits, modeled symmetrically.
+        self.per_step_s = per_step_s
         self.calls: list[str] = []
         self.batch_sizes: list[int] = []
         self.references_seen: list[str | None] = []
@@ -164,9 +181,18 @@ class FakeBackend:
             uncached = sum(len(p.split()) for p in prompts)
             self._cache_report = []
         t0 = time.monotonic() if current_collector() is not None else 0.0
+        outs_early = None
         prefill_s = self.batch_overhead_s + self.per_token_s * uncached
-        if prefill_s or self.per_prompt_s:
-            time.sleep(prefill_s + self.per_prompt_s * len(prompts))
+        decode_s = self.per_prompt_s * len(prompts)
+        if self.per_step_s:
+            # the batch decodes until its LONGEST row finishes — every
+            # rider pays the convoy (what in-flight refill avoids)
+            outs_early = [self._one(p) for p in prompts]
+            decode_s += self.per_step_s * max(
+                (len(o.split()) for o in outs_early), default=0
+            )
+        if prefill_s or decode_s:
+            time.sleep(prefill_s + decode_s)
         # engine-telemetry contract mirror: the latency model's fixed
         # per-dispatch cost (plus the per-uncached-token prefill term) plays
         # the prefill phase and the marginal per-row cost plays decode, so
@@ -175,9 +201,11 @@ class FakeBackend:
         # scheduler installed a BatchTrace
         if t0:
             emit("prefill", t0, prefill_s, B=len(prompts))
-            emit("decode", t0 + prefill_s,
-                 self.per_prompt_s * len(prompts), B=len(prompts))
-        outs = [self._one(p) for p in prompts]
+            emit("decode", t0 + prefill_s, decode_s, B=len(prompts))
+        outs = (
+            outs_early if outs_early is not None
+            else [self._one(p) for p in prompts]
+        )
         k = config.spec_k if config is not None else self.spec_k
         self._spec_report = [
             self._synthetic_spec(k, references[i] if references else None, o)
@@ -229,3 +257,159 @@ class FakeBackend:
 
     def count_tokens_batch(self, texts: list[str]) -> list[int]:
         return [whitespace_token_count(t) for t in texts]
+
+    # -- in-flight slot loop (mirrors TpuBackend.start_slot_loop) --------
+
+    def start_slot_loop(
+        self,
+        slots: int | None = None,
+        *,
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+        prompt_tokens: int = 0,
+    ) -> "FakeSlotLoop":
+        """The in-flight batching contract, hermetically: admission runs the
+        REAL radix prefix index (when configured) and sleeps the prefill
+        model (batch_overhead_s + per_token_s * uncached words); each step()
+        advances live rows by ``segment_words`` words of their deterministic
+        extractive output and sleeps the segment model. ``prompt_tokens``
+        bounds admitted prompts exactly like the engine's S bucket (0 =
+        unlimited) so scheduler fallback paths are testable without a
+        device."""
+        max_new = max_new_tokens
+        if max_new is None and config is not None:
+            max_new = config.max_new_tokens
+        return FakeSlotLoop(self, slots or 8, prompt_tokens, max_new)
+
+
+class FakeSlotLoop:
+    """Slot-loop double over FakeBackend's latency + cache model; the
+    admission/segment/harvest contract matches backend/inflight.TpuSlotLoop
+    (shared record types), so serving tests and the hermetic bench exercise
+    the same scheduler paths the real engine loop serves."""
+
+    def __init__(self, backend: FakeBackend, slots: int, prompt_tokens: int,
+                 max_new: int | None) -> None:
+        from .inflight import SegmentResult, SlotAdmission, SlotCompletion
+
+        self._SegmentResult = SegmentResult
+        self._SlotAdmission = SlotAdmission
+        self._SlotCompletion = SlotCompletion
+        self.backend = backend
+        self.slots = int(slots)
+        self.S = int(prompt_tokens)  # 0 = unlimited
+        self.max_new = max_new
+        self._keys: list = [None] * self.slots
+        self._words: list[list[str] | None] = [None] * self.slots
+        self._emitted: list[int] = [0] * self.slots
+        self.segments = 0
+        self.refills = 0
+        self._closed = False
+
+    @property
+    def active(self) -> int:
+        return sum(1 for k in self._keys if k is not None)
+
+    @property
+    def free(self) -> int:
+        return self.slots - self.active
+
+    def admit(self, items):
+        if self._closed:
+            raise RuntimeError("slot loop is closed")
+        b = self.backend
+        t_admit = time.monotonic()
+        items = list(items)
+        if not items or not self.free:
+            return [], []
+        rejected = [
+            k for k, p, _h in items
+            if self.S and len(p.split()) > self.S
+        ]
+        ok = [(k, p, h) for k, p, h in items
+              if not (self.S and len(p.split()) > self.S)]
+        take = ok[: self.free]
+        if not take:
+            return [], rejected
+        prompts = [p for _k, p, _h in take]
+        hints = [h for _k, _p, h in take]
+        if b.prefix_index is not None:
+            uncached = b._cache_pass(prompts, hints)
+            report = b._cache_report
+            b._cache_report = []
+        else:
+            uncached = sum(len(p.split()) for p in prompts)
+            report = [0] * len(take)
+        prefill_s = b.batch_overhead_s + b.per_token_s * uncached
+        if prefill_s:
+            time.sleep(prefill_s)
+        prefill_end = time.monotonic()
+        emit("prefill", t_admit, prefill_end - t_admit, B=len(take))
+        free_slots = [s for s, k in enumerate(self._keys) if k is None]
+        admissions = []
+        occupancy = self.active + len(take)
+        for j, (key, prompt, _hint) in enumerate(take):
+            slot = free_slots[j]
+            words = b._one(prompt).split()
+            if self.max_new is not None:
+                words = words[: self.max_new]
+            self._keys[slot] = key
+            self._words[slot] = words
+            self._emitted[slot] = 0
+            admissions.append(self._SlotAdmission(
+                key=key, slot=slot, admitted_at=t_admit,
+                prefill_end=prefill_end,
+                prompt_tokens=len(prompt.split()),
+                cached_tokens=int(report[j]),
+                occupancy=occupancy,
+            ))
+        self.refills += len(take)
+        b.batch_sizes.append(len(take))
+        b.calls.extend(prompts)
+        return admissions, rejected
+
+    def step(self):
+        if self._closed:
+            raise RuntimeError("slot loop is closed")
+        res = self._SegmentResult(live=self.active)
+        if not res.live:
+            return res
+        b = self.backend
+        t0 = time.monotonic()
+        steps = 0
+        for s, k in enumerate(self._keys):
+            if k is None:
+                continue
+            words = self._words[s]
+            advance = min(b.segment_words, len(words) - self._emitted[s])
+            steps = max(steps, advance)
+            self._emitted[s] += advance
+            res.new_tokens += advance
+        seg_s = (
+            b.segment_overhead_s
+            + b.per_slot_segment_s * res.live
+            + b.per_step_s * steps
+        )
+        if seg_s:
+            time.sleep(seg_s)
+        for s, k in enumerate(self._keys):
+            if k is None:
+                continue
+            words = self._words[s]
+            if self._emitted[s] >= len(words):
+                res.completions.append(self._SlotCompletion(
+                    key=k, text=" ".join(words), slot=s,
+                    gen_tokens=len(words),
+                ))
+                self._keys[s] = None
+                self._words[s] = None
+        self.segments += 1
+        res.seconds = time.monotonic() - t0
+        emit("decode_seg", t0, res.seconds, live=res.live, refill=True)
+        return res
+
+    def outstanding(self) -> list:
+        return [k for k in self._keys if k is not None]
+
+    def close(self) -> None:
+        self._closed = True

@@ -1,0 +1,362 @@
+"""In-flight batching: a persistent slot-based decode loop with refill.
+
+The serving path used to be Orca-before-Orca: the scheduler coalesced a
+micro-batch, called a blocking ``backend.generate``, and every request that
+arrived during that batch's decode waited out the full prefill+decode of
+strangers. The engine already owned every ingredient of iteration-level
+scheduling — segmented decode with a host-visible boundary, tail compaction,
+chunked prefill, prefix-cache resume — and this module assembles them into
+the missing loop (Orca OSDI'22; vLLM/PagedAttention arXiv:2309.06180's
+continuous batching is the same lever over paged memory):
+
+- a long-lived fixed-shape batch of B *slots* (one compiled program set per
+  loop — no per-batch bucketing churn);
+- per-slot state (budget ``t``, done flag, RNG uid, output cursor) is
+  slot-indexed, so rows at different generation depths coexist
+  (``engine._make_slot_segment_fn``'s per-row budgets);
+- at every segment boundary, finished rows are harvested and freed slots
+  are REFILLED from waiting prompts: joiners get chunked prefill (optionally
+  resumed from the radix prefix cache) into a small join batch, then an
+  adopt program scatters their cache rows into the resident stacked cache
+  (``engine._make_adopt_fn``) and they decode together with residents.
+
+Greedy per-request outputs stay byte-identical to the one-shot path (same
+caveat class as compaction: identical per-row math, batch-shape tiling can
+flip near-tie last bits on real hardware; CPU/interpret runs are exact).
+Sampled streams key on (loop seed, per-request uid, row-local step), so a
+request's randomness is independent of its slot, its join segment, and its
+companions.
+
+The loop is driven from ONE thread (the serving scheduler's contract —
+engine access is single-threaded); nothing here locks.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..analysis.sanitizers import hot_path_transfer_guard
+from ..core.logging import get_logger
+from ..obs.trace import current_collector, emit
+from .base import left_pad_batch
+
+# jax is imported lazily (TpuSlotLoop.__init__): the shared record types
+# below also serve FakeBackend's hermetic slot loop, which must not pay a
+# cold jax import on its first admission
+
+logger = get_logger("vnsum.inflight")
+
+
+@dataclass
+class SlotAdmission:
+    """One request's admission into the loop (the TTFT anchor rides here:
+    ``prefill_end`` is the sync-bounded host time the joiner's own prefill
+    finished — anchored at the JOINER's prefill, not a shared batch's)."""
+
+    key: object
+    slot: int
+    admitted_at: float          # time.monotonic() at admit entry
+    prefill_end: float          # time.monotonic() after the prefill sync
+    prompt_tokens: int = 0
+    cached_tokens: int = 0      # prompt tokens resumed from the prefix cache
+    occupancy: int = 0          # busy slots right after this admit
+
+
+@dataclass
+class SlotCompletion:
+    """One finished request harvested at a segment boundary."""
+
+    key: object
+    text: str
+    slot: int
+    gen_tokens: int = 0
+
+
+@dataclass
+class SegmentResult:
+    """One decode segment's outcome."""
+
+    completions: list = field(default_factory=list)
+    live: int = 0               # rows live at segment start
+    new_tokens: int = 0         # tokens retired across all rows this segment
+    seconds: float = 0.0
+
+
+def _pow2_floor(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+class TpuSlotLoop:
+    """Slot bookkeeping + program driving for TpuBackend's in-flight loop.
+
+    Built by ``TpuBackend.start_slot_loop``; the compiled programs live in
+    the backend's ``_seg_fns`` cache (``slot_prefill`` / ``slot_seg`` /
+    ``adopt``), so loops over the same geometry reuse executables.
+    """
+
+    def __init__(self, backend, slots: int, S: int, max_new: int, gen,
+                 seed: int) -> None:
+        import jax.numpy as jnp
+
+        self.backend = backend
+        self.slots = int(slots)
+        self.S = int(S)
+        self.max_new = int(max_new)
+        self.gen = gen
+        self.seed = seed
+        b = backend
+        B = self.slots
+        # resident device state: every slot starts FREE (all-pad, done)
+        self._cache = b._init_prefill_cache(B, S + max_new)
+        self._cur = jnp.zeros((B,), jnp.int32)
+        self._done = jnp.ones((B,), bool)
+        self._t = jnp.zeros((B,), jnp.int32)
+        self._out = jnp.full((B, max_new), b.tok.pad_id, jnp.int32)
+        self._pads = jnp.full((B,), S, jnp.int32)
+        # host-side slot table: caller key per busy slot (None = free),
+        # per-request RNG uid, last fetched per-row t
+        self._keys: list = [None] * B
+        self._uids: list[int] = [0] * B
+        self._admissions: dict[int, SlotAdmission] = {}
+        self._t_host = np.zeros((B,), np.int64)
+        self._uid_next = 0
+        self.segments = 0
+        self.refills = 0
+        self._closed = False
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        return sum(1 for k in self._keys if k is not None)
+
+    @property
+    def free(self) -> int:
+        return self.slots - self.active
+
+    # -- admission (prefill + adopt) -------------------------------------
+
+    # hot path
+    def admit(self, items) -> tuple[list[SlotAdmission], list]:
+        """Admit up to the free-slot budget from ``items`` (an iterable of
+        ``(key, prompt, cache_hint)``). Returns (admissions, rejected_keys):
+        rejected keys had prompts longer than the loop's S budget and must
+        be routed through the one-shot path by the caller; items beyond the
+        admitted count are simply not consumed (the caller retries at the
+        next segment boundary). Join groups are power-of-two bucketed and
+        capped at the free-slot count so every scatter target — including
+        all-pad filler rows — lands on a distinct free slot."""
+        if self._closed:
+            raise RuntimeError("slot loop is closed")
+        import jax
+        import jax.numpy as jnp
+
+        b = self.backend
+        t_admit = time.monotonic()
+        tracing = current_collector() is not None
+        items = list(items)
+        if not items or not self.free:
+            return [], []
+        keys = [it[0] for it in items]
+        prompts = [it[1] for it in items]
+        hints = [it[2] for it in items]
+        encoded = b.tok.encode_batch(prompts, add_bos=True)
+        rejected = [
+            keys[i] for i in range(len(items)) if len(encoded[i]) > self.S
+        ]
+        ok = [i for i in range(len(items)) if len(encoded[i]) <= self.S]
+        if not ok:
+            return [], rejected
+        free_slots = [s for s, k in enumerate(self._keys) if k is None]
+        n = min(len(ok), len(free_slots))
+        Bj = 1
+        while Bj < n:
+            Bj *= 2
+        if Bj > len(free_slots):
+            # the bucket's filler rows need free slots too — shrink the
+            # admit to the largest power of two that fits outright
+            n = Bj = _pow2_floor(len(free_slots))
+        take = ok[:n]
+
+        pc = b.prefix_cache
+        matches = None
+        if pc is not None:
+            matches = {i: pc.match(encoded[i], max_tokens=len(encoded[i]) - 1)
+                       for i in take}
+            # order the join group by UNCOVERED suffix so its shared resume
+            # boundary K is as deep as the coldest row allows (same policy
+            # as generate()'s cache ordering)
+            take.sort(key=lambda i: (len(encoded[i]) - matches[i].tokens,
+                                     len(encoded[i])))
+        try:
+            group_ids = [encoded[i] for i in take]
+            group_hints = [hints[i] for i in take]
+            tokens, pad_lens = left_pad_batch(
+                group_ids, Bj, self.S, b.tok.pad_id
+            )
+            resume = None
+            if matches is not None:
+                group_matches = [matches[i] for i in take]
+                resume = b._prepare_resume(
+                    list(range(len(take))), group_ids, group_matches,
+                    pad_lens, Bj, self.S, self.max_new, tracing,
+                )
+            K = resume[0] if resume else 0
+            uids = [self._uid_next + j for j in range(len(take))]
+            self._uid_next += len(take)
+            uids_np = np.zeros((Bj,), np.int32)
+            uids_np[: len(take)] = uids
+            prefill = b._get_seg_fn(
+                "slot_prefill", Bj, self.S, self.max_new, self.gen, K
+            )
+            t_pre = time.monotonic()
+            with hot_path_transfer_guard():
+                if resume:
+                    first, join_cache, done0 = prefill(
+                        b.params, tokens, pad_lens, self.seed, uids_np,
+                        resume[1],
+                    )
+                else:
+                    first, join_cache, done0 = prefill(
+                        b.params, tokens, pad_lens, self.seed, uids_np
+                    )
+                if pc is not None:
+                    # prefix-cache insertion reads the join cache BEFORE the
+                    # adopt dispatch donates it (copies enter the stream
+                    # first, same ordering argument as the continuous path)
+                    b._cache_insert(
+                        join_cache, list(range(len(take))), group_ids,
+                        group_matches, group_hints, pad_lens, tracing,
+                    )
+                # the joiners' first token IS their TTFT: bound the prefill
+                # dispatch with the cheapest output so the anchor is honest
+                # lint-allow[host-sync-in-hot-path]: sync makes the per-joiner TTFT anchor real, one [Bj] bool fetch per admit
+                jax.device_get(done0)
+                prefill_end = time.monotonic()
+                # lint-allow[host-sync-in-hot-path]: host list -> host array for the scatter indices, no device sync
+                slot_idx = np.asarray(free_slots[:Bj], np.int32)
+                adopt = b._get_seg_fn(
+                    "adopt", Bj, self.S, self.max_new, self.gen
+                )
+                (self._cache, self._cur, self._done, self._t, self._out,
+                 self._pads) = adopt(
+                    self._cache, self._cur, self._done, self._t, self._out,
+                    self._pads, join_cache, first, done0,
+                    jnp.asarray(pad_lens), slot_idx,
+                )
+        finally:
+            if matches is not None:
+                for m in matches.values():
+                    pc.release(m)
+
+        skipped = resume[2] if resume else [0] * len(take)
+        admissions: list[SlotAdmission] = []
+        occupancy = self.active + len(take)
+        for j, i in enumerate(take):
+            slot = free_slots[j]
+            self._keys[slot] = keys[i]
+            self._uids[slot] = uids[j]
+            self._t_host[slot] = 0
+            adm = SlotAdmission(
+                key=keys[i], slot=slot, admitted_at=t_admit,
+                prefill_end=prefill_end,
+                prompt_tokens=len(encoded[i]),
+                cached_tokens=int(skipped[j]),
+                occupancy=occupancy,
+            )
+            self._admissions[slot] = adm
+            admissions.append(adm)
+        self.refills += len(take)
+        st = b.stats
+        st.batches += 1
+        st.prompts += len(take)
+        st.prompt_tokens += sum(len(group_ids[j]) for j in range(len(take)))
+        st.by_bucket[(Bj, self.S)] = st.by_bucket.get((Bj, self.S), 0) + 1
+        if pc is not None:
+            hit = sum(skipped)
+            st.cache_hit_tokens += hit
+            st.cache_miss_tokens += sum(len(g) for g in group_ids) - hit
+        if tracing:
+            emit("prefill", t_pre, prefill_end - t_pre, B=Bj, S=self.S,
+                 occupancy=len(take), synced=True)
+        return admissions, rejected
+
+    # -- one decode segment ----------------------------------------------
+
+    # hot path
+    def step(self) -> SegmentResult:
+        """Advance every live slot by up to ``segment_tokens`` tokens, then
+        harvest finished rows at the boundary. The done/t fetch IS the
+        segment boundary — the same control sync the continuous path pays."""
+        if self._closed:
+            raise RuntimeError("slot loop is closed")
+        res = SegmentResult(live=self.active)
+        if not res.live:
+            return res
+        import jax
+
+        b = self.backend
+        tracing = current_collector() is not None
+        seg_fn = b._get_seg_fn(
+            "slot_seg", self.slots, self.S, self.max_new, self.gen
+        )
+        t0 = time.monotonic()
+        with hot_path_transfer_guard():
+            # lint-allow[host-sync-in-hot-path]: host list -> host array for the uids argument, no device sync
+            uids_np = np.asarray(self._uids, np.int32)
+            (self._t, self._cur, self._cache, self._done,
+             self._out) = seg_fn(
+                b.params, self._t, self._cur, self._cache, self._done,
+                uids_np, self._out, self._pads, self.seed,
+            )
+            # ONE explicit fetch for both control values, exactly like the
+            # continuous path's segment boundary
+            # lint-allow[host-sync-in-hot-path]: segment-boundary done/t fetch is the loop's control dependency
+            done_h, t_h = jax.device_get((self._done, self._t))
+            finished = [
+                s for s, k in enumerate(self._keys)
+                if k is not None and done_h[s]
+            ]
+            out_h = None
+            if finished:
+                # lint-allow[host-sync-in-hot-path]: harvesting finished rows' tokens before their slots are refilled
+                out_h = jax.device_get(self._out)
+        res.seconds = time.monotonic() - t0
+        res.new_tokens = int(
+            sum(int(t_h[s]) - int(self._t_host[s])
+                for s, k in enumerate(self._keys) if k is not None)
+        )
+        for s, k in enumerate(self._keys):
+            if k is not None:
+                self._t_host[s] = int(t_h[s])
+        for s in finished:
+            text = b._detok(out_h[s], tuple(self.gen.eos_ids))
+            res.completions.append(SlotCompletion(
+                key=self._keys[s], text=text, slot=s,
+                gen_tokens=int(t_h[s]),
+            ))
+            self._keys[s] = None
+            self._admissions.pop(s, None)
+        self.segments += 1
+        if tracing:
+            emit("decode_seg", t0, res.seconds, B=self.slots, S=self.S,
+                 live=res.live, refill=True)
+        return res
+
+    # -- lifecycle -------------------------------------------------------
+
+    def outstanding(self) -> list:
+        """Keys still resident (the caller drains before closing)."""
+        return [k for k in self._keys if k is not None]
+
+    def close(self) -> None:
+        self._closed = True
+        # drop the device state promptly — the resident cache is the big
+        # HBM tenant, and a replacement loop allocates its own
+        self._cache = None
+        self._cur = self._done = self._t = self._out = self._pads = None

@@ -155,6 +155,11 @@ class ServingStats:
     accepted_tokens: int = 0
     # prefix KV cache aggregate: prompt tokens served from cached blocks
     cache_hit_tokens: int = 0
+    # in-flight batching (serve/inflight.py): decode segments dispatched by
+    # the slot loop, and requests admitted into a RUNNING decode batch at a
+    # segment boundary (0 for the batch-dispatch scheduler)
+    segments: int = 0
+    refills: int = 0
 
     @property
     def shed_total(self) -> int:

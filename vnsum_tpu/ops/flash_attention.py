@@ -247,6 +247,25 @@ def flash_prefill_attention(
     # sublane-aligned when S is small and not 8-divisible
     bq = -(-bq // 8) * 8
     bk = min(block_k or default_bk, C)
+    # the bk guard above bottoms out at 512; very wide GQA groups (G > 12)
+    # can still blow the scoped-VMEM score budget there, so continue the
+    # scaling on bq (the q tile and the per-head [bq, bk] f32 temporaries
+    # both shrink with it). G*bq*bk <= 3*2048*512 is the measured-working
+    # ceiling at the default geometry (G=3, bq=512, bk=2048).
+    _VMEM_CELLS = 3 * 2048 * 512
+    if block_q is None:
+        while G * bq * bk > _VMEM_CELLS and bq > 8:
+            bq = max(-(-(bq // 2) // 8) * 8, 8)
+    if G * bq * bk > _VMEM_CELLS and not interpret:
+        # an explicit block_q/block_k overrode the autoscaler into a
+        # geometry that will OOM in Mosaic — fail with the numbers instead
+        # of a compile-time scoped-vmem error naming none of them
+        raise ValueError(
+            f"flash prefill geometry exceeds the ~16 MB scoped-VMEM "
+            f"budget: G={G} (H={H}/KV={KV}), head_dim={hd}, bq={bq}, "
+            f"bk={bk} (G*bq*bk={G * bq * bk} > {_VMEM_CELLS}) — pass a "
+            f"smaller block_q/block_k or drop to the dense path"
+        )
 
     # group-major query layout: [B, KV, G, S, hd] — the grid walks KV
     # heads, so one grid cell computes the whole GQA group against each

@@ -15,6 +15,11 @@ This package is the missing online front-end for the batched engine:
                 existing strategies submit their rounds through the queue;
                 installs the obs BatchTrace collector around each engine
                 dispatch and derives per-request TTFT from its prefill end
+- inflight.py   in-flight batching: slot-feeding scheduler over the
+                backend's persistent decode loop (start_slot_loop) —
+                finished rows are harvested and freed slots refilled from
+                the queue at every segment boundary, TTFT anchored at each
+                joiner's own prefill
 - metrics.py    per-request + aggregate observability: counters, rolling
                 gauges, and fixed-bucket histograms (queue wait / TTFT /
                 e2e / occupancy / accepted-per-step) in Prometheus text;
@@ -29,9 +34,11 @@ thread-safe), and concurrency lives entirely in front of it.
 """
 from .queue import RequestQueue, RequestShed, ServeRequest, ShedReason
 from .scheduler import MicroBatchScheduler, QueuedBackend
+from .inflight import InflightScheduler
 from .metrics import ServeMetrics
 
 __all__ = [
+    "InflightScheduler",
     "MicroBatchScheduler",
     "QueuedBackend",
     "RequestQueue",

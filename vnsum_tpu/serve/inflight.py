@@ -1,0 +1,273 @@
+"""In-flight scheduler: slot-feeding over a persistent engine decode loop.
+
+`MicroBatchScheduler` is batch-dispatch: coalesce, call a blocking
+``backend.generate``, repeat — every request that arrives mid-batch waits
+out the full prefill+decode of strangers. This scheduler replaces the
+dispatch loop with *slot feeding* over the backend's in-flight slot loop
+(``backend.start_slot_loop``, Orca-style iteration-level scheduling): one
+long-lived fixed-shape decode batch where, at every segment boundary,
+finished rows are harvested and freed slots are refilled straight from the
+queue (``RequestQueue.take_upto`` — admission billed per slot). Joiners get
+their own chunked prefill (optionally resumed from the radix prefix cache),
+so per-request TTFT is anchored at the JOINER's prefill end — not at a
+shared batch's — and a request's time-to-first-token no longer includes
+strangers' decode.
+
+Policy notes:
+
+- **compatibility**: a loop serves ONE batch key (max_new_tokens +
+  GenerationConfig — the same coalescing rule as batch dispatch). Requests
+  with other keys wait; compatible later arrivals may leapfrog them into
+  free slots, but an incompatible head-of-line older than
+  ``switch_grace_s`` stops refills so the loop drains and is rebuilt for
+  the new key (bounded unfairness instead of starvation).
+- **oversized prompts**: prompts beyond the loop's prompt bucket are
+  rejected at admit and served through the classic batch-dispatch path
+  (``_run_batch``) between segments — the offline one-shot program remains
+  the path of record for them.
+- **speculation**: the slot loop has no spec-decode variant; references are
+  ignored in in-flight mode (greedy outputs are identical either way).
+
+Everything else — submission, admission control, deadline shedding,
+QueuedBackend strategy fan-out, metrics surfaces — is inherited from
+MicroBatchScheduler; only the engine-side loop differs.
+"""
+from __future__ import annotations
+
+import time
+
+from ..backend.base import Backend
+from ..core.logging import get_logger
+from ..core.results import ServeRequestRecord
+from .queue import RequestShed, ServeRequest, ShedReason
+from .scheduler import MicroBatchScheduler, _Completion
+
+logger = get_logger("vnsum.serve.inflight")
+
+
+class InflightScheduler(MicroBatchScheduler):
+    def __init__(
+        self,
+        backend: Backend,
+        *,
+        slots: int | None = None,
+        slot_prompt_tokens: int = 0,
+        switch_grace_s: float = 0.5,
+        **kw,
+    ) -> None:
+        if not callable(getattr(backend, "start_slot_loop", None)):
+            raise ValueError(
+                f"backend {getattr(backend, 'name', backend)!r} does not "
+                "expose start_slot_loop; use MicroBatchScheduler"
+            )
+        # set before super().__init__ — the base constructor starts the
+        # scheduler thread, which reads these immediately
+        self.slots = slots or kw.get("max_batch", 8)
+        self.slot_prompt_tokens = slot_prompt_tokens
+        self.switch_grace_s = switch_grace_s
+        # live loop reference for scrape-time gauges (written only by the
+        # scheduler thread; racy reads yield a stale gauge, never a crash)
+        self._live_loop = None
+        super().__init__(backend, **kw)
+
+    # -- scrape surface ---------------------------------------------------
+
+    def slot_state(self) -> tuple[int, int] | None:
+        """(slots_total, slots_busy) for /metrics, or None when no loop is
+        resident yet."""
+        loop = self._live_loop
+        if loop is None:
+            return (self.slots, 0)
+        return (loop.slots, loop.active)
+
+    # -- scheduler thread -------------------------------------------------
+
+    def _loop(self) -> None:
+        loop = None
+        loop_key = None
+        pending: list[ServeRequest] = []
+        draining = False  # queue closed: serve what remains, then exit
+        while True:
+            try:
+                active = loop.active if loop is not None else 0
+                if not draining and not pending:
+                    taken = self._take(loop, loop_key, active)
+                    if taken is None:
+                        draining = True
+                    else:
+                        pending.extend(taken)
+                if draining and not pending and not active:
+                    self._close_loop(loop)
+                    return
+                if pending and not active:
+                    key = pending[0].batch_key()
+                    if loop is None or key != loop_key:
+                        self._close_loop(loop)
+                        loop = self._make_loop(pending[0])
+                        loop_key = key
+                if (
+                    pending
+                    and loop is not None
+                    and pending[0].batch_key() == loop_key
+                    and loop.free
+                ):
+                    pending = self._admit(loop, pending)
+                if loop is not None and loop.active:
+                    self._run_segment(loop)
+            except Exception as e:  # pragma: no cover - belt and braces
+                # a loop failure must not kill serving: fail every resident
+                # and pending future with the error — recorded in metrics
+                # and traces like the base scheduler's errored batches —
+                # drop the loop, and keep taking new work on a fresh one
+                logger.exception("in-flight loop failed; rebuilding")
+                now = time.monotonic()
+                for r in self._evict_all(loop, pending):
+                    adm = getattr(r, "inflight_admission", None)
+                    t0 = adm.admitted_at if adm is not None else now
+                    rec = ServeRequestRecord(
+                        request_id=r.request_id, status="error",
+                        trace_id=r.trace_id,
+                        queue_wait_s=max(t0 - r.enqueued_at, 0.0),
+                        engine_s=max(now - t0, 0.0),
+                        total_s=max(now - r.enqueued_at, 0.0),
+                        prompt_tokens=r.est_tokens,
+                    )
+                    self.metrics.observe_request(rec)
+                    self._trace_request(r, t0, max(now - t0, 0.0), None,
+                                        "error")
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                loop, loop_key, pending = None, None, []
+
+    def _take(self, loop, loop_key, active: int):
+        """One queue interaction: blocking for the head when idle,
+        non-blocking slot-feeding when decoding."""
+        if not active:
+            return self.queue.take_upto(
+                self.slots, wait_s=max(self.max_wait_s, 0.05)
+            )
+        if loop is None or not loop.free:
+            return []
+        head = self.queue.head_snapshot()
+        if (
+            head is not None
+            and head[0] != loop_key
+            and time.monotonic() - head[1] > self.switch_grace_s
+        ):
+            # an incompatible head has waited long enough: stop refilling
+            # so the resident batch drains and the loop is rebuilt for it
+            return []
+        return self.queue.take_upto(loop.free, key=loop_key)
+
+    def _make_loop(self, head: ServeRequest):
+        loop = self.backend.start_slot_loop(
+            self.slots,
+            max_new_tokens=head.max_new_tokens,
+            config=head.config,
+            prompt_tokens=self.slot_prompt_tokens,
+        )
+        self._live_loop = loop
+        return loop
+
+    def _close_loop(self, loop) -> None:
+        if loop is not None:
+            self._live_loop = None
+            loop.close()
+
+    def _evict_all(self, loop, pending: list[ServeRequest]):
+        """Collect every request still owed an answer after a loop failure."""
+        stranded = list(pending)
+        if loop is not None:
+            stranded.extend(loop.outstanding())
+            self._close_loop(loop)
+        self._live_loop = None
+        return stranded
+
+    # -- admission ---------------------------------------------------------
+
+    def _admit(self, loop, pending: list[ServeRequest]) -> list[ServeRequest]:
+        now = time.monotonic()
+        live: list[ServeRequest] = []
+        for r in pending:
+            if r.expired(now):
+                # the queue sheds expired requests it still holds; taken-but
+                # -unadmitted ones are this scheduler's to shed — including
+                # the owned-trace finalization the queue-side _on_shed hook
+                # performs, so SLO-miss requests still reach /debug/trace
+                self.metrics.observe_shed(ShedReason.DEADLINE)
+                if r.own_trace and r.trace is not None and self.obs is not None:
+                    self.obs.finish_request(r.trace, "shed:deadline")
+                    r.trace = None
+                if not r.future.done():
+                    r.future.set_exception(RequestShed(ShedReason.DEADLINE))
+            else:
+                live.append(r)
+        pending = live
+        if not pending or not loop.free:
+            return pending
+        was_running = loop.active > 0
+        items = [(r, r.prompt, r.cache_hint) for r in pending[: loop.free]]
+        admissions, rejected = loop.admit(items)
+        admitted_ids = {id(a.key) for a in admissions}
+        rejected_ids = {id(k) for k in rejected}
+        for adm in admissions:
+            r: ServeRequest = adm.key
+            r.inflight_admission = adm  # read back at harvest
+        if admissions:
+            prefill_s = admissions[0].prefill_end - admissions[0].admitted_at
+            self.metrics.observe_batch(len(admissions), prefill_s)
+            if was_running:
+                self.metrics.observe_refill(len(admissions))
+        if rejected:
+            # prompts beyond the loop's S bucket: classic batch dispatch
+            # between segments (residents wait one blocking generate —
+            # bounded by the oversized request itself, and the one-shot
+            # program stays the path of record for it)
+            fallback = [r for r in pending if id(r) in rejected_ids]
+            logger.info(
+                "dispatching %d oversized request(s) via the one-shot path",
+                len(fallback),
+            )
+            self._run_batch(fallback)
+        return [
+            r for r in pending
+            if id(r) not in admitted_ids and id(r) not in rejected_ids
+        ]
+
+    # -- segment + harvest --------------------------------------------------
+
+    def _run_segment(self, loop) -> None:
+        res = loop.step()
+        self.metrics.observe_segment(res.live, res.seconds, res.new_tokens)
+        now = time.monotonic()
+        for c in res.completions:
+            r: ServeRequest = c.key
+            adm = getattr(r, "inflight_admission", None)
+            t_admit = adm.admitted_at if adm is not None else now
+            engine_s = now - t_admit
+            rec = ServeRequestRecord(
+                request_id=r.request_id,
+                status="ok",
+                trace_id=r.trace_id,
+                queue_wait_s=max(t_admit - r.enqueued_at, 0.0),
+                engine_s=engine_s,
+                total_s=max(now - r.enqueued_at, 0.0),
+                # TTFT anchored at the JOINER's own prefill end — the whole
+                # point of refill: first-token time no longer includes
+                # strangers' decode
+                ttft_s=max(
+                    (adm.prefill_end if adm is not None else now)
+                    - r.enqueued_at, 0.0,
+                ),
+                ttft_anchored=adm is not None,
+                batch_size=adm.occupancy if adm is not None else res.live,
+                prompt_tokens=r.est_tokens,
+                generated_tokens=c.gen_tokens,
+            )
+            rec.cached_prompt_tokens = (
+                adm.cached_tokens if adm is not None else 0
+            )
+            self.metrics.observe_request(rec)
+            self._trace_request(r, t_admit, engine_s, None, "ok")
+            if not r.future.done():
+                r.future.set_result(_Completion(c.text, rec))

@@ -85,6 +85,14 @@ _reg("cache_evictions_total", "counter",
 _reg("cache_blocks_used", "gauge",
      "prefix-cache blocks currently allocated")
 _reg("cache_blocks_total", "gauge", "prefix-cache block budget")
+_reg("inflight_segments_total", "counter",
+     "decode segments dispatched by the in-flight slot loop")
+_reg("inflight_refills_total", "counter",
+     "requests admitted into a running decode batch at a segment boundary")
+_reg("slots_total", "gauge",
+     "decode slots of the in-flight loop (scrape-time; in-flight mode only)")
+_reg("slots_busy", "gauge",
+     "decode slots occupied at scrape (in-flight mode only)")
 _reg("queue_depth", "gauge", "requests currently queued")
 _reg("queued_tokens", "gauge",
      "billable (uncached) prompt-token estimate currently queued")
@@ -97,6 +105,8 @@ _reg("ttft_seconds", "histogram",
 _reg("e2e_seconds", "histogram",
      "end-to-end request latency (submit -> completion)")
 _reg("batch_occupancy", "histogram", "engine batch occupancy at dispatch")
+_reg("slot_occupancy", "histogram",
+     "busy slots per in-flight decode segment")
 _reg("spec_accepted_per_step", "histogram",
      "accepted draft tokens per verify step, per request")
 
@@ -124,6 +134,7 @@ class ServeMetrics:
             "ttft_seconds": Histogram(TTFT_BUCKETS_S),
             "e2e_seconds": Histogram(E2E_BUCKETS_S),
             "batch_occupancy": Histogram(OCCUPANCY_BUCKETS),
+            "slot_occupancy": Histogram(OCCUPANCY_BUCKETS),
             "spec_accepted_per_step": Histogram(ACCEPT_BUCKETS),
         }
         self._rolling_accept = Rolling(256)     # guarded by: _lock
@@ -148,6 +159,22 @@ class ServeMetrics:
             self._stats.engine_seconds += engine_s
             self._hists["batch_occupancy"].observe(occupancy)
             self._rolling_tps.add(gen_tokens, engine_s)
+
+    def observe_segment(self, live: int, seg_s: float,
+                        gen_tokens: int = 0) -> None:
+        """One in-flight decode segment: slot occupancy, engine residency,
+        and the tokens it retired (feeds the rolling tokens/s gauge the way
+        observe_batch does for batch dispatches)."""
+        with self._lock:
+            self._stats.segments += 1
+            self._stats.engine_seconds += seg_s
+            self._hists["slot_occupancy"].observe(live)
+            self._rolling_tps.add(gen_tokens, seg_s)
+
+    def observe_refill(self, n: int = 1) -> None:
+        """Requests admitted into a RUNNING decode batch at a boundary."""
+        with self._lock:
+            self._stats.refills += n
 
     def observe_request(self, rec: ServeRequestRecord) -> None:
         with self._lock:
@@ -191,7 +218,8 @@ class ServeMetrics:
 
     def render_prometheus(self, queue_depth: int | None = None,
                           queued_tokens: int | None = None,
-                          cache_stats: dict | None = None) -> str:
+                          cache_stats: dict | None = None,
+                          slot_state: tuple[int, int] | None = None) -> str:
         """``cache_stats`` is the backend's prefix_cache_stats() snapshot
         (evictions / blocks_used / blocks_total), read at scrape time like
         the queue gauges — the serving layer never mirrors pool state."""
@@ -238,6 +266,13 @@ class ServeMetrics:
         simple("spec_acceptance_rolling", round(rolling_accept, 6))
         simple("cache_hit_tokens_total", s.cache_hit_tokens)
         simple("cache_hit_rate", round(s.cache_hit_rate, 6))
+        simple("inflight_segments_total", s.segments)
+        simple("inflight_refills_total", s.refills)
+        if slot_state is not None:
+            # (total, busy) read from the live slot loop at scrape time,
+            # like the queue gauges — the metrics layer never mirrors it
+            simple("slots_total", slot_state[0])
+            simple("slots_busy", slot_state[1])
         if cache_stats is not None:
             simple("cache_evictions_total", cache_stats.get("evictions", 0))
             simple("cache_blocks_used", cache_stats.get("blocks_used", 0))

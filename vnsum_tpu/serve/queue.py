@@ -224,6 +224,36 @@ class RequestQueue:
                 live.append(r)
         self._items = live
 
+    def _compat_locked(self, key: tuple, max_take: int) -> list[ServeRequest]:
+        """Requests sharing ``key``, FIFO — with prefix-cache clustering
+        (vnsum_tpu.cache) when more compatible requests wait than one take
+        holds: fill with the head's cache_hint group first, because the
+        engine's usable prefill skip is bounded by the batch's coldest row,
+        so mixing hint groups wastes everyone's cached prefix. FIFO order
+        is preserved within each part, and nothing reorders when the take
+        drains everyone anyway. The ONE compatibility/clustering policy for
+        take_batch and take_upto — the two paths must never diverge."""
+        compat = [r for r in self._items if r.batch_key() == key]
+        if len(compat) > max_take and any(r.cache_hint for r in compat):
+            hint = compat[0].cache_hint
+            compat = (
+                [r for r in compat if r.cache_hint == hint]
+                + [r for r in compat if r.cache_hint != hint]
+            )
+        return compat
+
+    def _take_locked(self, compat: list[ServeRequest],
+                     max_take: int) -> list[ServeRequest]:
+        """Remove up to ``max_take`` of ``compat`` from the queue and
+        release their token bill — the ONE removal/billing block shared by
+        both take paths."""
+        batch = compat[:max_take]
+        taken = set(id(r) for r in batch)
+        self._items = [r for r in self._items if id(r) not in taken]
+        for r in batch:
+            self._queued_tokens -= r.billable_tokens
+        return batch
+
     def take_batch(self, max_batch: int, max_wait_s: float) -> list[ServeRequest] | None:
         """Block until a batch is ready, then return up to ``max_batch``
         requests sharing the head-of-line request's batch_key. A batch is
@@ -251,30 +281,45 @@ class RequestQueue:
                     self._cond.wait(timeout=0.1)
                     continue
                 head = self._items[0]
-                key = head.batch_key()
-                compat = [r for r in self._items if r.batch_key() == key]
-                # prefix-cache clustering (vnsum_tpu.cache): when more
-                # compatible requests wait than one batch holds, fill it
-                # with the head's cache_hint group first — the engine's
-                # usable prefill skip is bounded by the batch's coldest
-                # row, so mixing hint groups wastes everyone's cached
-                # prefix. FIFO order is preserved within each part, and
-                # nothing reorders when the batch drains everyone anyway.
-                if len(compat) > max_batch and any(r.cache_hint for r in compat):
-                    hint = head.cache_hint
-                    compat = (
-                        [r for r in compat if r.cache_hint == hint]
-                        + [r for r in compat if r.cache_hint != hint]
-                    )
+                compat = self._compat_locked(head.batch_key(), max_batch)
                 flush_at = max(head.enqueued_at, t_enter) + max_wait_s
                 if len(compat) >= max_batch or now >= flush_at or self._closed:
-                    batch = compat[:max_batch]
-                    taken = set(id(r) for r in batch)
-                    self._items = [r for r in self._items if id(r) not in taken]
-                    for r in batch:
-                        self._queued_tokens -= r.billable_tokens
-                    return batch
+                    return self._take_locked(compat, max_batch)
                 self._cond.wait(timeout=max(flush_at - now, 0.001))
+
+    def take_upto(
+        self, max_take: int, key: tuple | None = None, wait_s: float = 0.0
+    ) -> list[ServeRequest] | None:
+        """Slot-feeding take for the in-flight scheduler: up to ``max_take``
+        requests compatible with ``key`` (None = the head-of-line request's
+        batch_key), FIFO within the key with the same cache-hint clustering
+        as take_batch. Admission is billed per slot: each request's billable
+        tokens leave the queue budget when its slot is taken, not when a
+        whole batch flushes.
+
+        Unlike take_batch there is no coalescing window — the decode
+        segment cadence provides natural coalescing — but a positive
+        ``wait_s`` blocks up to that long for the FIRST compatible request
+        (the idle-loop case). Returns [] when nothing compatible arrived in
+        time, and None when the queue is closed and drained (the caller's
+        exit signal). Expired requests are shed on every wake-up."""
+        if max_take < 1:
+            return []
+        t_end = time.monotonic() + wait_s
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                self._shed_expired_locked(now)
+                if self._items:
+                    k = key if key is not None else self._items[0].batch_key()
+                    compat = self._compat_locked(k, max_take)
+                    if compat:
+                        return self._take_locked(compat, max_take)
+                elif self._closed:
+                    return None
+                if now >= t_end:
+                    return []
+                self._cond.wait(timeout=max(t_end - now, 0.001))
 
     # -- lifecycle / introspection ---------------------------------------
 
@@ -292,6 +337,17 @@ class RequestQueue:
                         r.future.set_exception(RequestShed(ShedReason.SHUTDOWN))
                 self._items = []
             self._cond.notify_all()
+
+    def head_snapshot(self) -> tuple[tuple, float] | None:
+        """(batch_key, enqueued_at) of the head-of-line request, or None —
+        the in-flight scheduler's fairness probe: a head whose key can't
+        ride the resident slot loop eventually forces a drain instead of
+        being leapfrogged forever by compatible later arrivals."""
+        with self._lock:
+            if not self._items:
+                return None
+            head = self._items[0]
+            return head.batch_key(), head.enqueued_at
 
     @property
     def depth(self) -> int:

@@ -78,6 +78,9 @@ class ServeState:
         trace_sample: float = 1.0,
         trace_ring: int = 256,
         trace_dir: str | None = None,
+        inflight: bool = False,
+        slots: int | None = None,
+        slot_prompt_tokens: int = 0,
     ) -> None:
         self.backend = backend
         # mirrors the backend's GenerationConfig(spec_k=...) default so a
@@ -97,8 +100,7 @@ class ServeState:
             # device_profile() call in this process now lands its XLA trace
             # next to the Chrome dumps written here
             os.environ.setdefault("VNSUM_PROFILE_DIR", trace_dir)
-        self.scheduler = MicroBatchScheduler(
-            backend,
+        common = dict(
             max_batch=max_batch,
             max_wait_s=max_wait_s,
             max_queue_depth=max_queue_depth,
@@ -106,6 +108,18 @@ class ServeState:
             obs=self.obs,
             trace_dir=trace_dir,
         )
+        if inflight:
+            # in-flight batching (serve/inflight.py): slot-feeding over the
+            # backend's persistent decode loop — joiners enter at segment
+            # boundaries instead of waiting out strangers' batches
+            from .inflight import InflightScheduler
+
+            self.scheduler = InflightScheduler(
+                backend, slots=slots,
+                slot_prompt_tokens=slot_prompt_tokens, **common,
+            )
+        else:
+            self.scheduler = MicroBatchScheduler(backend, **common)
         self.default_deadline_s = default_deadline_s
         self._strategies: dict[str, object] = {}
         import threading
@@ -278,11 +292,15 @@ def make_handler(state: ServeState):
                 cache_stats = getattr(
                     state.backend, "prefix_cache_stats", lambda: None
                 )()
+                slot_state = getattr(
+                    state.scheduler, "slot_state", lambda: None
+                )()
                 self._text(
                     state.scheduler.metrics.render_prometheus(
                         queue_depth=state.scheduler.queue.depth,
                         queued_tokens=state.scheduler.queue.queued_tokens,
                         cache_stats=cache_stats,
+                        slot_state=slot_state,
                     )
                 )
             else:
@@ -532,6 +550,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="engine batch ceiling per dispatch")
     p.add_argument("--max-wait-ms", type=float, default=10.0,
                    help="max time a head-of-line request waits for company")
+    p.add_argument("--inflight", action="store_true",
+                   help="in-flight batching: admit new requests into the "
+                        "running decode batch at segment boundaries "
+                        "(tpu/fake backends; greedy outputs identical)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="in-flight decode slots (default: --max-batch)")
+    p.add_argument("--slot-prompt-tokens", type=int, default=0,
+                   help="in-flight prompt bucket S; longer prompts fall "
+                        "back to one-shot dispatch (0 = full context)")
     p.add_argument("--max-queue", type=int, default=256,
                    help="admission control: max queued requests")
     p.add_argument("--max-queued-tokens", type=int, default=0,
@@ -599,6 +626,9 @@ def main(argv: list[str] | None = None) -> int:
         trace_sample=args.trace_sample,
         trace_ring=args.trace_ring,
         trace_dir=args.trace_dir,
+        inflight=args.inflight,
+        slots=args.slots,
+        slot_prompt_tokens=args.slot_prompt_tokens,
     )
     server = make_server(state, args.host, args.port)
     logger.info(

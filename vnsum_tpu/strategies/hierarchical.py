@@ -53,7 +53,11 @@ class HierarchicalStrategy:
         self.splitter = RecursiveTokenSplitter(
             self.chunk_size, chunk_overlap,
             length_function=backend.count_tokens,
-            length_batch_function=backend.count_tokens_batch,
+            # duck-typed backends without the batch method keep working via
+            # the splitter's scalar fallback
+            length_batch_function=getattr(
+                backend, "count_tokens_batch", None
+            ),
         )
 
     @classmethod

@@ -39,7 +39,11 @@ class IterativeStrategy:
             config.iterative_chunk_size,
             config.iterative_chunk_overlap,
             length_function=backend.count_tokens,
-            length_batch_function=backend.count_tokens_batch,
+            # duck-typed backends without the batch method keep working via
+            # the splitter's scalar fallback
+            length_batch_function=getattr(
+                backend, "count_tokens_batch", None
+            ),
         )
         return cls(backend, splitter, max_new_tokens=config.max_new_tokens, **kw)
 

@@ -48,7 +48,11 @@ class MapReduceStrategy:
         splitter = RecursiveTokenSplitter(
             config.chunk_size, config.chunk_overlap,
             length_function=backend.count_tokens,
-            length_batch_function=backend.count_tokens_batch,
+            # duck-typed backends without the batch method keep working via
+            # the splitter's scalar fallback
+            length_batch_function=getattr(
+                backend, "count_tokens_batch", None
+            ),
         )
         return cls(
             backend, splitter, token_max=config.token_max,
