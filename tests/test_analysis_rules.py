@@ -344,3 +344,84 @@ def test_repo_is_clean_under_its_own_lint():
         capture_output=True, text=True, cwd=REPO_ROOT,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- swallowed-exception -----------------------------------------------------
+
+
+def _serve_lint(tmp_path, src: str):
+    """The swallowed-exception rule is scoped to vnsum_tpu/{serve,backend}/ —
+    fixtures must live on such a path to be checked at all."""
+    d = tmp_path / "vnsum_tpu" / "serve"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "snippet.py"
+    f.write_text(textwrap.dedent(src), encoding="utf-8")
+    return run_paths([f], root=tmp_path, rules=["swallowed-exception"])
+
+
+SWALLOWED_SRC = """
+    def handler(req, logger):
+        try:
+            dispatch(req)
+        except Exception:
+            logger.exception("oops")   # swallowed: future never resolves
+"""
+
+
+def test_swallowed_exception_flags_log_and_continue(tmp_path):
+    findings = _serve_lint(tmp_path, SWALLOWED_SRC)
+    assert len(findings) == 1
+    assert findings[0].rule == "swallowed-exception"
+
+
+def test_swallowed_exception_accepts_resolution_forms(tmp_path):
+    findings = _serve_lint(tmp_path, """
+        def a(req):
+            try:
+                dispatch(req)
+            except Exception as e:
+                req.future.set_exception(e)       # resolves the future
+
+        def b(req):
+            try:
+                dispatch(req)
+            except Exception:
+                raise                              # re-raises
+
+        def c(self, req):
+            try:
+                dispatch(req)
+            except Exception as e:
+                self._resolve_errored([req], e)    # resolver-helper convention
+
+        def d(self):
+            try:
+                return primary()
+            except TypeError:
+                return fallback()                  # explicit fallback value
+
+        def e(self, req):
+            try:
+                dispatch(req)
+            except Exception as exc:
+                self._json({"error": str(exc)}, 500)  # HTTP layer answers
+    """)
+    assert findings == []
+
+
+def test_swallowed_exception_suppression_and_scope(tmp_path):
+    # a reasoned lint-allow clears it
+    findings = _serve_lint(tmp_path, """
+        def handler(req, logger):
+            try:
+                dispatch(req)
+            # lint-allow[swallowed-exception]: nothing was taken, nothing to resolve
+            except Exception:
+                logger.exception("oops")
+    """)
+    assert findings == []
+    # outside serve/ and backend/, the same code is out of scope
+    f = tmp_path / "other.py"
+    f.write_text(textwrap.dedent(SWALLOWED_SRC), encoding="utf-8")
+    assert run_paths([f], root=tmp_path,
+                     rules=["swallowed-exception"]) == []

@@ -52,6 +52,7 @@ def fake_requests(monkeypatch):
         return item
 
     def get(url, timeout=None):
+        mod.calls.append({"url": url, "json": None, "timeout": timeout})
         item = mod.responses.pop(0)
         item._requests = mod
         return item
@@ -151,3 +152,36 @@ def test_health_check(fake_requests):
         FakeResponse({"models": [{"name": "llama3.2:3b"}, {"name": "qwen3:8b"}]})
     ]
     assert OllamaBackend().health_check() == ["llama3.2:3b", "qwen3:8b"]
+
+
+def test_split_connect_read_timeouts_on_every_request(fake_requests):
+    """A dead host must fail at the TCP handshake (seconds), not burn the
+    600 s read budget: every HTTP call passes the (connect, read) tuple."""
+    fake_requests.responses = [FakeResponse({"response": "ok"})]
+    be = OllamaBackend(timeout=600.0, connect_timeout=3.5)
+    assert be.generate(["p"]) == ["ok"]
+    assert fake_requests.calls[0]["timeout"] == (3.5, 600.0)
+
+    fake_requests.calls.clear()
+    fake_requests.responses = [FakeResponse({"models": []})]
+    be.health_check()
+    assert fake_requests.calls[0]["timeout"] == (3.5, 10)
+
+
+def test_retry_backoff_is_jittered_and_bounded(fake_requests, monkeypatch):
+    """Retries from `concurrency` pool workers must not re-slam a
+    recovering server in lockstep: delays carry multiplicative jitter in
+    [base, base * (1 + jitter)]."""
+    delays = []
+    monkeypatch.setattr("time.sleep", lambda s: delays.append(s))
+    fake_requests.responses = [
+        fake_requests.ConnectionError("down"),
+        fake_requests.ConnectionError("down"),
+        FakeResponse({"response": "ok"}),
+    ]
+    be = OllamaBackend(max_retries=3, retry_backoff=1.0, retry_jitter=0.5)
+    assert be.generate(["p"]) == ["ok"]
+    assert len(delays) == 2
+    # exponential base doubles; each delay within its jitter band
+    assert 1.0 <= delays[0] <= 1.5
+    assert 2.0 <= delays[1] <= 3.0

@@ -1,4 +1,11 @@
 """The domain rule set. Importing this package registers every rule with
 :mod:`vnsum_tpu.analysis.core`; add a module here and import it below to
 ship a new rule."""
-from . import donation, guarded_by, host_sync, metrics_doc, recompile  # noqa: F401
+from . import (  # noqa: F401
+    donation,
+    guarded_by,
+    host_sync,
+    metrics_doc,
+    recompile,
+    swallowed,
+)

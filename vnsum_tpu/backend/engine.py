@@ -45,6 +45,7 @@ from .base import (
 )
 from ..core.profiling import annotate
 from ..obs.trace import current_collector, emit
+from ..testing.faults import fault
 from ..models.llama import (
     LlamaConfig,
     decode_attention_mask,
@@ -268,6 +269,10 @@ class TpuBackend:
         self.prefix_cache = None
         self._cache_report: list = []
         self._hint_ids_cache: dict[str, list[int]] = {}
+        # degradation-ladder hook (serve/supervisor.py NO_CACHE_INSERT):
+        # False stops pool insertion/eviction churn while matched prefixes
+        # keep serving resume-prefill hits
+        self.cache_inserts_enabled = True
         if cache_blocks:
             if mesh is not None:
                 raise ValueError(
@@ -1508,6 +1513,9 @@ class TpuBackend:
         unique content tails don't churn the pool; without one the whole
         prompt (minus its last token) is insertable and LRU manages it."""
         pc = self.prefix_cache
+        if not self.cache_inserts_enabled:
+            # ladder rung NO_CACHE_INSERT: stop pool churn; hits still serve
+            return 0
         BLK = pc.block_tokens
         t0 = time.time()
         t0_m = time.monotonic() if tracing else 0.0
@@ -1545,6 +1553,13 @@ class TpuBackend:
         while k < n and hint_ids[k] == ids[k]:
             k += 1
         return k
+
+    def set_prefix_cache_inserts(self, enabled: bool) -> None:
+        """Degradation-ladder hook (serve/supervisor.py): gate prefix-cache
+        insertion while hits keep serving. Engine-thread-only, like every
+        generate() call — the serving scheduler applies rung changes
+        lazily on its own thread for exactly this reason."""
+        self.cache_inserts_enabled = bool(enabled)
 
     def cached_prefix_tokens(self, text: str, cache_hint: str | None = None) -> int:
         """Read-only probe: how many prompt tokens the prefix cache would
@@ -1636,6 +1651,10 @@ class TpuBackend:
                 f"cache_hints must align with prompts: got {len(cache_hints)} "
                 f"for {len(prompts)}"
             )
+        # seeded fault injection (vnsum_tpu.testing.faults): one global
+        # None-check when disarmed; sits after input validation so injected
+        # faults exercise DISPATCH recovery, not the argument checks
+        fault("engine.dispatch", prompts=prompts)
 
         # reference-guided speculative decoding: needs spec_k > 0 AND at
         # least one reference to draft from. The multi-position verify path

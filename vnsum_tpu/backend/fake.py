@@ -41,6 +41,7 @@ import time
 from ..core.config import GenerationConfig
 from ..obs.trace import current_collector, emit
 from ..spec import SpecRecord
+from ..testing.faults import fault
 from ..text.tokenizer import whitespace_token_count
 
 _BLOCK = re.compile(
@@ -104,6 +105,10 @@ class FakeBackend:
         # slot loop pays only for the steps a segment actually runs. This
         # is the economics in-flight refill exploits, modeled symmetrically.
         self.per_step_s = per_step_s
+        # degradation-ladder hook (serve/supervisor.py NO_CACHE_INSERT):
+        # False stops prefix-index insertion while hits keep serving —
+        # same contract as TpuBackend.set_prefix_cache_inserts
+        self.cache_inserts_enabled = True
         self.calls: list[str] = []
         self.batch_sizes: list[int] = []
         self.references_seen: list[str | None] = []
@@ -136,23 +141,34 @@ class FakeBackend:
         matches = [
             idx.match(w, max_tokens=len(w) - 1) for w in words_per
         ]
-        for i, (w, m) in enumerate(zip(words_per, matches)):
-            hint = cache_hints[i] if cache_hints else None
-            if hint:
-                # mirror the engine's _hint_prefix_len: the hint bounds
-                # insertion only up to its true common prefix with the
-                # prompt — a hint the prompt doesn't start with caches
-                # nothing, instead of caching unique content by length
-                hw = hint.split()
-                upto = 0
-                while (
-                    upto < min(len(hw), len(w)) and hw[upto] == w[upto]
-                ):
-                    upto += 1
-            else:
-                upto = len(w) - 1
-            idx.insert(w, min(upto, len(w) - 1))
-            idx.release(m)
+        # pins released on EVERY path: a fault firing mid-pass (the
+        # fake.prefill injection site sits exactly here, while the matched
+        # chains are pinned) must not leak refcounts — leaked pins would
+        # make blocks uneviciable forever, the serving-stack analogue of a
+        # KV-block leak on a crashed device batch
+        try:
+            fault("fake.prefill", prompts=prompts)
+            if self.cache_inserts_enabled:
+                for i, (w, m) in enumerate(zip(words_per, matches)):
+                    hint = cache_hints[i] if cache_hints else None
+                    if hint:
+                        # mirror the engine's _hint_prefix_len: the hint
+                        # bounds insertion only up to its true common prefix
+                        # with the prompt — a hint the prompt doesn't start
+                        # with caches nothing, instead of caching unique
+                        # content by length
+                        hw = hint.split()
+                        upto = 0
+                        while (
+                            upto < min(len(hw), len(w)) and hw[upto] == w[upto]
+                        ):
+                            upto += 1
+                    else:
+                        upto = len(w) - 1
+                    idx.insert(w, min(upto, len(w) - 1))
+        finally:
+            for m in matches:
+                idx.release(m)
         self._cache_report = [m.tokens for m in matches]
         return sum(
             len(w) - m.tokens for w, m in zip(words_per, matches)
@@ -167,6 +183,10 @@ class FakeBackend:
         references: list[str | None] | None = None,
         cache_hints: list[str | None] | None = None,
     ) -> list[str]:
+        # seeded fault injection (vnsum_tpu.testing.faults): free when
+        # disarmed; fires BEFORE call bookkeeping so a retried dispatch is
+        # indistinguishable from a fresh one to the latency model
+        fault("fake.dispatch", prompts=prompts)
         self.calls.extend(prompts)
         self.batch_sizes.append(len(prompts))
         self.references_seen.extend(
@@ -239,6 +259,11 @@ class FakeBackend:
         report, self._cache_report = self._cache_report, []
         return report
 
+    def set_prefix_cache_inserts(self, enabled: bool) -> None:
+        """Degradation-ladder hook: gate prefix-index insertion (hits still
+        serve). Engine-thread-only, like every other mutation here."""
+        self.cache_inserts_enabled = bool(enabled)
+
     def cached_prefix_tokens(self, text: str, cache_hint: str | None = None) -> int:
         """Read-only probe in whitespace-word tokens (consistent with
         count_tokens) — the admission-discount hook."""
@@ -301,6 +326,7 @@ class FakeSlotLoop:
         self.max_new = max_new
         self._keys: list = [None] * self.slots
         self._words: list[list[str] | None] = [None] * self.slots
+        self._prompts: list[str | None] = [None] * self.slots
         self._emitted: list[int] = [0] * self.slots
         self.segments = 0
         self.refills = 0
@@ -322,6 +348,7 @@ class FakeSlotLoop:
         items = list(items)
         if not items or not self.free:
             return [], []
+        fault("fake.slot_admit", prompts=[p for _k, p, _h in items])
         rejected = [
             k for k, p, _h in items
             if self.S and len(p.split()) > self.S
@@ -355,6 +382,7 @@ class FakeSlotLoop:
                 words = words[: self.max_new]
             self._keys[slot] = key
             self._words[slot] = words
+            self._prompts[slot] = prompt
             self._emitted[slot] = 0
             admissions.append(self._SlotAdmission(
                 key=key, slot=slot, admitted_at=t_admit,
@@ -374,6 +402,11 @@ class FakeSlotLoop:
         res = self._SegmentResult(live=self.active)
         if not res.live:
             return res
+        # resident prompts ride the poison matcher: a poison RESIDENT
+        # crashes segments, not just its own admission
+        fault("fake.slot_step", prompts=[
+            p for p in self._prompts if p is not None
+        ])
         b = self.backend
         t0 = time.monotonic()
         steps = 0
@@ -403,6 +436,7 @@ class FakeSlotLoop:
                 ))
                 self._keys[s] = None
                 self._words[s] = None
+                self._prompts[s] = None
         self.segments += 1
         res.seconds = time.monotonic() - t0
         emit("decode_seg", t0, res.seconds, live=res.live, refill=True)

@@ -17,6 +17,7 @@ from __future__ import annotations
 from ..core.config import GenerationConfig
 
 from .base import resolve_max_new
+from ..core.faults import call_with_retries, is_retryable
 from ..core.logging import get_logger
 from ..text.cleaning import clean_thinking_tokens
 
@@ -38,9 +39,39 @@ class HFBackend:
         use_chat_template: bool = True,
         clean_output: bool = True,
         torch_dtype=None,
+        load_retries: int = 2,
+        load_backoff: float = 1.0,
+        hub_timeout_s: float = 10.0,
     ) -> None:
+        import os
+
+        # HTTP hygiene for the only network path this backend has — hub
+        # downloads inside from_pretrained: bound the connect/read phases.
+        # huggingface_hub reads these envs AT MODULE IMPORT (constants.py),
+        # so they must be set BEFORE the transformers import below pulls it
+        # in; without them a dead proxy hangs on the library's much larger
+        # defaults.
+        os.environ.setdefault("HF_HUB_ETAG_TIMEOUT", str(int(hub_timeout_s)))
+        os.environ.setdefault(
+            "HF_HUB_DOWNLOAD_TIMEOUT", str(int(hub_timeout_s))
+        )
+
         import torch
         from transformers import AutoModelForCausalLM, AutoTokenizer
+
+        # belt and braces: when huggingface_hub was imported BEFORE this
+        # constructor (its constants module snapshots the env at import),
+        # the setdefaults above changed nothing — overwrite the live
+        # constants too, so the bound applies regardless of import order
+        # and of per-instance hub_timeout_s values
+        import sys as _sys
+
+        hub_constants = getattr(
+            _sys.modules.get("huggingface_hub"), "constants", None
+        )
+        if hub_constants is not None:
+            hub_constants.HF_HUB_ETAG_TIMEOUT = int(hub_timeout_s)
+            hub_constants.HF_HUB_DOWNLOAD_TIMEOUT = int(hub_timeout_s)
 
         self._torch = torch
         self.model_name = model_name_or_path
@@ -50,14 +81,39 @@ class HFBackend:
         self.use_chat_template = use_chat_template
         self.clean_output = clean_output
 
+        def _load_should_retry(e: BaseException) -> bool:
+            # transformers raises PLAIN OSError for permanent problems
+            # ("not a local folder and is not a valid model identifier"),
+            # while genuinely transient network failures arrive as OSError
+            # SUBCLASSES (requests.ConnectionError, timeouts) — so fail
+            # fast on the exact type, retry the rest through the shared
+            # PERMANENT_ERRORS filter
+            if type(e) is OSError:
+                return False
+            return is_retryable(e)
+
+        def _load(what, fn):
+            return call_with_retries(
+                fn,
+                max_retries=load_retries,
+                backoff=load_backoff,
+                jitter=0.25,
+                should_retry=_load_should_retry,
+                what=what,
+            )
+
         # injectable for tests / pre-loaded models (no hub access on TPU hosts)
-        self.tokenizer = tokenizer or AutoTokenizer.from_pretrained(
-            model_name_or_path
+        self.tokenizer = tokenizer or _load(
+            f"load tokenizer {model_name_or_path}",
+            lambda: AutoTokenizer.from_pretrained(model_name_or_path),
         )
         if model is None:
-            model = AutoModelForCausalLM.from_pretrained(
-                model_name_or_path,
-                torch_dtype=torch_dtype or torch.float32,
+            model = _load(
+                f"load model {model_name_or_path}",
+                lambda: AutoModelForCausalLM.from_pretrained(
+                    model_name_or_path,
+                    torch_dtype=torch_dtype or torch.float32,
+                ),
             )
         self.model = model.to(device).eval()
         if self.tokenizer.pad_token_id is None:

@@ -40,6 +40,7 @@ import numpy as np
 from ..analysis.sanitizers import hot_path_transfer_guard
 from ..core.logging import get_logger
 from ..obs.trace import current_collector, emit
+from ..testing.faults import fault
 from .base import left_pad_batch
 
 # jax is imported lazily (TpuSlotLoop.__init__): the shared record types
@@ -119,8 +120,11 @@ class TpuSlotLoop:
         self._out = jnp.full((B, max_new), b.tok.pad_id, jnp.int32)
         self._pads = jnp.full((B,), S, jnp.int32)
         # host-side slot table: caller key per busy slot (None = free),
-        # per-request RNG uid, last fetched per-row t
+        # per-request RNG uid, last fetched per-row t; prompts are kept so
+        # the fault-injection poison matcher sees residents at every
+        # segment, symmetric with FakeSlotLoop
         self._keys: list = [None] * B
+        self._prompts: list[str | None] = [None] * B
         self._uids: list[int] = [0] * B
         self._admissions: dict[int, SlotAdmission] = {}
         self._t_host = np.zeros((B,), np.int64)
@@ -162,6 +166,10 @@ class TpuSlotLoop:
         items = list(items)
         if not items or not self.free:
             return [], []
+        # seeded fault injection (vnsum_tpu.testing.faults); no-op unless a
+        # plan is armed. Any raise propagates with the matched chains still
+        # unpinned (matching happens below) or released by the finally
+        fault("engine.slot_admit", prompts=[it[1] for it in items])
         keys = [it[0] for it in items]
         prompts = [it[1] for it in items]
         hints = [it[2] for it in items]
@@ -260,6 +268,7 @@ class TpuSlotLoop:
         for j, i in enumerate(take):
             slot = free_slots[j]
             self._keys[slot] = keys[i]
+            self._prompts[slot] = prompts[i]
             self._uids[slot] = uids[j]
             self._t_host[slot] = 0
             adm = SlotAdmission(
@@ -298,6 +307,8 @@ class TpuSlotLoop:
         res = SegmentResult(live=self.active)
         if not res.live:
             return res
+        fault("engine.slot_step",
+              prompts=[p for p in self._prompts if p is not None])
         import jax
 
         b = self.backend
@@ -341,6 +352,7 @@ class TpuSlotLoop:
                 gen_tokens=int(t_h[s]),
             ))
             self._keys[s] = None
+            self._prompts[s] = None
             self._admissions.pop(s, None)
         self.segments += 1
         if tracing:

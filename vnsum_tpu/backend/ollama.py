@@ -35,26 +35,42 @@ class OllamaBackend:
         url: str = "http://localhost:11434",
         max_new_tokens: int = 1024,
         timeout: float = 600.0,
+        connect_timeout: float = 5.0,
         clean_output: bool = True,
         concurrency: int = 4,
         max_retries: int = 3,
         retry_backoff: float = 1.0,
+        retry_jitter: float = 0.25,
     ) -> None:
         self.model = model
         self.url = url.rstrip("/")
         self.max_new_tokens = max_new_tokens
+        # split (connect, read) timeouts: a dead host fails in seconds at
+        # the TCP handshake instead of burning the 600 s READ budget a slow
+        # generation legitimately needs — requests accepts the tuple form
         self.timeout = timeout
+        self.connect_timeout = connect_timeout
         self.clean_output = clean_output
         self.concurrency = concurrency
         self.max_retries = max(0, max_retries)
         self.retry_backoff = retry_backoff
+        # jittered backoff: this backend fans prompts over a thread pool,
+        # and unjittered retries from `concurrency` workers re-slam a
+        # recovering server in lockstep
+        self.retry_jitter = retry_jitter
+
+    @property
+    def _timeouts(self) -> tuple[float, float]:
+        return (self.connect_timeout, self.timeout)
 
     def health_check(self) -> list[str]:
         """GET /api/tags; returns available model names
         (ref run_full_evaluation_pipeline.py:199-233)."""
         import requests
 
-        resp = requests.get(f"{self.url}/api/tags", timeout=10)
+        resp = requests.get(
+            f"{self.url}/api/tags", timeout=(self.connect_timeout, 10)
+        )
         resp.raise_for_status()
         return [m["name"] for m in resp.json().get("models", [])]
 
@@ -79,7 +95,8 @@ class OllamaBackend:
         }
         def attempt() -> str:
             resp = requests.post(
-                f"{self.url}/api/generate", json=payload, timeout=self.timeout
+                f"{self.url}/api/generate", json=payload,
+                timeout=self._timeouts,
             )
             resp.raise_for_status()
             text = resp.json()["response"]
@@ -118,6 +135,7 @@ class OllamaBackend:
             attempt,
             max_retries=self.max_retries,
             backoff=self.retry_backoff,
+            jitter=self.retry_jitter,
             retryable=(
                 requests.ConnectionError,
                 requests.HTTPError,

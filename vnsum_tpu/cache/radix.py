@@ -99,6 +99,22 @@ class RadixIndex:
         with self._lock:
             return self.num_blocks - len(self._free)
 
+    @property
+    def pinned_blocks(self) -> int:
+        """Blocks with a live refcount — what an un-released Match leaks.
+        O(nodes) trie walk; a debug/assertion surface (the fault-injection
+        tests pin that a crashed dispatch returns this to its pre-batch
+        level), never on the serving path."""
+        with self._lock:
+            count = 0
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                if node.refs > 0:
+                    count += 1
+                stack.extend(node.children.values())
+            return count
+
     def stats_dict(self) -> dict:
         with self._lock:
             d = self.stats.to_dict()

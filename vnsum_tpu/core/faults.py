@@ -113,16 +113,23 @@ def call_with_retries(
     *,
     max_retries: int,
     backoff: float = 1.0,
+    max_backoff: float = 60.0,
+    jitter: float = 0.0,
+    rng: random.Random | None = None,
     retryable: tuple[type[BaseException], ...] = (Exception,),
     should_retry=None,
     what: str = "call",
 ):
-    """Run fn(); on a retryable failure wait backoff * 2^attempt and rerun,
-    up to max_retries extra attempts (negative clamps to 0 — fn always runs
-    at least once). ``should_retry(exc) -> bool`` refines the class filter
-    (e.g. retry only 5xx HTTP errors); a non-retryable failure re-raises
-    immediately. Re-raises the last failure."""
+    """Run fn(); on a retryable failure wait min(backoff * 2^attempt,
+    max_backoff) * (1 + jitter * U[0,1)) and rerun, up to max_retries extra
+    attempts (negative clamps to 0 — fn always runs at least once).
+    ``jitter`` desynchronizes concurrent retriers (thundering-herd control;
+    pass a seeded ``rng`` for deterministic tests). ``should_retry(exc) ->
+    bool`` refines the class filter (e.g. retry only 5xx HTTP errors); a
+    non-retryable failure re-raises immediately. Re-raises the last
+    failure."""
     max_retries = max(max_retries, 0)
+    rng = rng or random
     for attempt in range(max_retries + 1):
         try:
             return fn()
@@ -131,7 +138,9 @@ def call_with_retries(
                 raise
             if attempt >= max_retries:
                 raise
-            delay = backoff * (2 ** attempt)
+            delay = min(backoff * (2 ** attempt), max_backoff)
+            if jitter:
+                delay *= 1.0 + jitter * rng.random()
             logger.warning(
                 "%s failed (%s: %s); retry %d/%d in %.1fs",
                 what, type(e).__name__, e, attempt + 1, max_retries, delay,

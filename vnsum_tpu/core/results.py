@@ -160,6 +160,16 @@ class ServingStats:
     # segment boundary (0 for the batch-dispatch scheduler)
     segments: int = 0
     refills: int = 0
+    # fault tolerance (serve/supervisor.py): classified dispatch failures,
+    # retries scheduled, bisection splits, requests quarantined as poison,
+    # total backoff slept, and degradation-ladder transitions
+    failures: dict[str, int] = field(default_factory=dict)  # class -> count
+    retries: int = 0
+    bisects: int = 0
+    quarantined: int = 0
+    backoff_seconds: float = 0.0
+    degraded_steps: int = 0
+    degraded_recoveries: int = 0
 
     @property
     def shed_total(self) -> int:

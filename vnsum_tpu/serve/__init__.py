@@ -20,6 +20,12 @@ This package is the missing online front-end for the batched engine:
                 finished rows are harvested and freed slots refilled from
                 the queue at every segment boundary, TTFT anchored at each
                 joiner's own prefill
+- supervisor.py engine supervision: failure classification (transient /
+                resource-exhausted / poison / fatal), bounded jittered
+                retry with a per-request budget, batch bisection that
+                quarantines poison requests, and the graceful-degradation
+                ladder (shrink batch -> no spec -> no cache inserts ->
+                typed 503 brownout, with recovery probes)
 - metrics.py    per-request + aggregate observability: counters, rolling
                 gauges, and fixed-bucket histograms (queue wait / TTFT /
                 e2e / occupancy / accepted-per-step) in Prometheus text;
@@ -36,13 +42,27 @@ from .queue import RequestQueue, RequestShed, ServeRequest, ShedReason
 from .scheduler import MicroBatchScheduler, QueuedBackend
 from .inflight import InflightScheduler
 from .metrics import ServeMetrics
+from .supervisor import (
+    EngineSupervisor,
+    FailureClass,
+    FatalEngineError,
+    RequestFailed,
+    RetryPolicy,
+    Rung,
+)
 
 __all__ = [
+    "EngineSupervisor",
+    "FailureClass",
+    "FatalEngineError",
     "InflightScheduler",
     "MicroBatchScheduler",
     "QueuedBackend",
+    "RequestFailed",
     "RequestQueue",
     "RequestShed",
+    "RetryPolicy",
+    "Rung",
     "ServeMetrics",
     "ServeRequest",
     "ShedReason",

@@ -27,6 +27,13 @@ Policy notes:
   the path of record for them.
 - **speculation**: the slot loop has no spec-decode variant; references are
   ignored in in-flight mode (greedy outputs are identical either way).
+- **fault tolerance**: a loop crash (admit or segment) evicts every
+  resident — slots freed, radix pins released by the loop's own finally
+  paths — and, when a supervisor is configured, re-runs stranded requests
+  through the SUPERVISED one-shot dispatch path grouped by batch key, so
+  retry/bisect/poison-quarantine are inherited rather than re-implemented;
+  the rebuilt loop then serves new work. Without a supervisor every
+  stranded future fails with the raw error (legacy contract).
 
 Everything else — submission, admission control, deadline shedding,
 QueuedBackend strategy fan-out, metrics surfaces — is inherited from
@@ -39,7 +46,7 @@ import time
 from ..backend.base import Backend
 from ..core.logging import get_logger
 from ..core.results import ServeRequestRecord
-from .queue import RequestShed, ServeRequest, ShedReason
+from .queue import ServeRequest, ShedReason
 from .scheduler import MicroBatchScheduler, _Completion
 
 logger = get_logger("vnsum.serve.inflight")
@@ -68,6 +75,9 @@ class InflightScheduler(MicroBatchScheduler):
         # live loop reference for scrape-time gauges (written only by the
         # scheduler thread; racy reads yield a stale gauge, never a crash)
         self._live_loop = None
+        # taken-but-not-yet-admitted requests (scheduler-thread state; an
+        # instance attribute so close() can shed them on drain overrun)
+        self._pending: list[ServeRequest] = []
         super().__init__(backend, **kw)
 
     # -- scrape surface ---------------------------------------------------
@@ -82,69 +92,139 @@ class InflightScheduler(MicroBatchScheduler):
 
     # -- scheduler thread -------------------------------------------------
 
+    def _take_limit(self) -> int:
+        """Slot budget under the degradation ladder: a rebuilt loop at
+        REDUCED_BATCH or below runs half the slots (a resident full-size
+        loop keeps its shape — shrinking applies at the next rebuild)."""
+        if self.supervisor is not None:
+            return self.supervisor.batch_limit(self.slots)
+        return self.slots
+
     def _loop(self) -> None:
         loop = None
         loop_key = None
-        pending: list[ServeRequest] = []
+        self._pending = []
         draining = False  # queue closed: serve what remains, then exit
         while True:
             try:
                 active = loop.active if loop is not None else 0
-                if not draining and not pending:
+                if not draining and not self._pending:
                     taken = self._take(loop, loop_key, active)
                     if taken is None:
                         draining = True
                     else:
-                        pending.extend(taken)
-                if draining and not pending and not active:
+                        self._pending.extend(taken)
+                if draining and not self._pending and not active:
                     self._close_loop(loop)
                     return
-                if pending and not active:
-                    key = pending[0].batch_key()
+                if self._pending and not active:
+                    key = self._pending[0].batch_key()
                     if loop is None or key != loop_key:
                         self._close_loop(loop)
-                        loop = self._make_loop(pending[0])
+                        loop = self._make_loop(self._pending[0])
                         loop_key = key
                 if (
-                    pending
+                    self._pending
                     and loop is not None
-                    and pending[0].batch_key() == loop_key
+                    and self._pending[0].batch_key() == loop_key
                     and loop.free
                 ):
-                    pending = self._admit(loop, pending)
+                    self._pending = self._admit(loop, self._pending)
                 if loop is not None and loop.active:
                     self._run_segment(loop)
-            except Exception as e:  # pragma: no cover - belt and braces
-                # a loop failure must not kill serving: fail every resident
-                # and pending future with the error — recorded in metrics
-                # and traces like the base scheduler's errored batches —
-                # drop the loop, and keep taking new work on a fresh one
-                logger.exception("in-flight loop failed; rebuilding")
-                now = time.monotonic()
-                for r in self._evict_all(loop, pending):
-                    adm = getattr(r, "inflight_admission", None)
-                    t0 = adm.admitted_at if adm is not None else now
-                    rec = ServeRequestRecord(
-                        request_id=r.request_id, status="error",
-                        trace_id=r.trace_id,
-                        queue_wait_s=max(t0 - r.enqueued_at, 0.0),
-                        engine_s=max(now - t0, 0.0),
-                        total_s=max(now - r.enqueued_at, 0.0),
-                        prompt_tokens=r.est_tokens,
-                    )
-                    self.metrics.observe_request(rec)
-                    self._trace_request(r, t0, max(now - t0, 0.0), None,
-                                        "error")
-                    if not r.future.done():
-                        r.future.set_exception(e)
-                loop, loop_key, pending = None, None, []
+                    if self.supervisor is not None:
+                        self.supervisor.record_success()
+                        self._apply_rung()
+            except Exception as e:  # exercised by tests/test_serve_faults.py
+                # a loop failure must not kill serving: every resident and
+                # pending request is evicted (slots freed, radix pins
+                # released by the loop's own finally paths) and resolved —
+                # retried through the supervised one-shot path when a
+                # supervisor is configured, failed with the raw error
+                # otherwise — then the loop is rebuilt for new work
+                logger.exception("in-flight loop failed; recovering")
+                stranded = self._evict_all(loop, self._pending)
+                loop, loop_key = None, None
+                self._pending = []
+                self._resolve_loop_failure(stranded, e)
+
+    def _resolve_loop_failure(self, stranded: list[ServeRequest],
+                              e: Exception) -> None:
+        """Resolve every request owed an answer after a slot-loop crash.
+
+        Supervised: the crash is classified and noted (ladder strikes
+        included), then survivors are re-run through the SUPERVISED one-shot
+        dispatch path (``_run_batch``) grouped by batch key — the slot
+        loop's per-request decode state died with it, and the one-shot
+        program recomputes from scratch, so retry/bisect/quarantine and
+        "every future resolves" are inherited rather than re-implemented.
+        Unsupervised: the legacy contract — every stranded future fails
+        with the raw error."""
+        from .supervisor import FailureClass
+
+        sup = self.supervisor
+        if sup is not None:
+            cls = sup.classify(e)
+            self.metrics.observe_failure(cls.value)
+            sup.note_failure(cls)
+            self._apply_rung()
+            if not stranded:
+                return
+            if cls is FailureClass.FATAL:
+                self._attempt_ctx = (time.monotonic(), 0.0, None)
+                self._resolve_failed(stranded, e, cls)
+                return
+            delay = sup.backoff_s(1)
+            self.metrics.observe_retry(len(stranded))
+            self.metrics.observe_backoff(delay)
+            for r in stranded:
+                self._trace_fault(r, "retry", cls.value, delay)
+            logger.warning(
+                "retrying %d stranded request(s) via the one-shot path "
+                "after %s loop failure (backoff %.3fs)",
+                len(stranded), cls.value, delay,
+            )
+            time.sleep(delay)
+            # group by batch key: residents share the dead loop's key, but
+            # pending may already carry the NEXT key awaiting a loop switch
+            # — mixing them in one generate would apply the head's params
+            # to everyone
+            groups: dict[tuple, list[ServeRequest]] = {}
+            for r in stranded:
+                groups.setdefault(r.batch_key(), []).append(r)
+            for group in groups.values():
+                self._run_batch(group)
+            return
+        now = time.monotonic()
+        for r in stranded:
+            adm = getattr(r, "inflight_admission", None)
+            t0 = adm.admitted_at if adm is not None else now
+            rec = ServeRequestRecord(
+                request_id=r.request_id, status="error",
+                trace_id=r.trace_id,
+                queue_wait_s=max(t0 - r.enqueued_at, 0.0),
+                engine_s=max(now - t0, 0.0),
+                total_s=max(now - r.enqueued_at, 0.0),
+                prompt_tokens=r.est_tokens,
+            )
+            self.metrics.observe_request(rec)
+            self._trace_request(r, t0, max(now - t0, 0.0), None, "error")
+            if not r.future.done():
+                r.future.set_exception(e)
+
+    def _stranded_snapshot(self) -> list[ServeRequest]:
+        stranded = list(self._pending)
+        loop = self._live_loop
+        if loop is not None:
+            stranded.extend(loop.outstanding())
+        return stranded
 
     def _take(self, loop, loop_key, active: int):
         """One queue interaction: blocking for the head when idle,
         non-blocking slot-feeding when decoding."""
         if not active:
             return self.queue.take_upto(
-                self.slots, wait_s=max(self.max_wait_s, 0.05)
+                self._take_limit(), wait_s=max(self.max_wait_s, 0.05)
             )
         if loop is None or not loop.free:
             return []
@@ -161,7 +241,7 @@ class InflightScheduler(MicroBatchScheduler):
 
     def _make_loop(self, head: ServeRequest):
         loop = self.backend.start_slot_loop(
-            self.slots,
+            self._take_limit(),
             max_new_tokens=head.max_new_tokens,
             config=head.config,
             prompt_tokens=self.slot_prompt_tokens,
@@ -194,12 +274,7 @@ class InflightScheduler(MicroBatchScheduler):
                 # -unadmitted ones are this scheduler's to shed — including
                 # the owned-trace finalization the queue-side _on_shed hook
                 # performs, so SLO-miss requests still reach /debug/trace
-                self.metrics.observe_shed(ShedReason.DEADLINE)
-                if r.own_trace and r.trace is not None and self.obs is not None:
-                    self.obs.finish_request(r.trace, "shed:deadline")
-                    r.trace = None
-                if not r.future.done():
-                    r.future.set_exception(RequestShed(ShedReason.DEADLINE))
+                self._shed_taken(r, ShedReason.DEADLINE)
             else:
                 live.append(r)
         pending = live

@@ -93,6 +93,22 @@ _reg("slots_total", "gauge",
      "decode slots of the in-flight loop (scrape-time; in-flight mode only)")
 _reg("slots_busy", "gauge",
      "decode slots occupied at scrape (in-flight mode only)")
+_reg("fault_failures_total", "counter",
+     "classified engine dispatch failures, by failure class")
+_reg("fault_retries_total", "counter",
+     "request retries scheduled by the supervisor")
+_reg("fault_bisects_total", "counter",
+     "batch bisection splits performed to quarantine a poison request")
+_reg("fault_quarantined_total", "counter",
+     "requests failed with RequestFailed(poison) after bisection")
+_reg("fault_backoff_seconds_total", "counter",
+     "total seconds the supervisor spent in retry backoff")
+_reg("degraded_rung", "gauge",
+     "current degradation-ladder rung (0=healthy .. 4=brownout; scrape-time)")
+_reg("degraded_steps_total", "counter",
+     "degradation-ladder step-downs (resource-failure strikes)")
+_reg("degraded_recoveries_total", "counter",
+     "degradation-ladder step-ups (recovery probes that passed)")
 _reg("queue_depth", "gauge", "requests currently queued")
 _reg("queued_tokens", "gauge",
      "billable (uncached) prompt-token estimate currently queued")
@@ -176,6 +192,41 @@ class ServeMetrics:
         with self._lock:
             self._stats.refills += n
 
+    # -- fault-tolerance hooks (serve/supervisor.py consumers) -----------
+
+    def observe_failure(self, failure_class: str) -> None:
+        """One classified engine dispatch failure (pre-recovery: a retried
+        batch counts here once per failed attempt, while requests_errored
+        counts only terminal per-request outcomes)."""
+        with self._lock:
+            f = self._stats.failures
+            f[failure_class] = f.get(failure_class, 0) + 1
+
+    def observe_retry(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.retries += n
+
+    def observe_bisect(self) -> None:
+        with self._lock:
+            self._stats.bisects += 1
+
+    def observe_quarantine(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.quarantined += n
+
+    def observe_backoff(self, seconds: float) -> None:
+        with self._lock:
+            self._stats.backoff_seconds += seconds
+
+    def observe_degraded(self, down: bool) -> None:
+        """One ladder transition: down=True is a step-down (strike
+        threshold), False a recovery step-up."""
+        with self._lock:
+            if down:
+                self._stats.degraded_steps += 1
+            else:
+                self._stats.degraded_recoveries += 1
+
     def observe_request(self, rec: ServeRequestRecord) -> None:
         with self._lock:
             if rec.status == "ok":
@@ -219,7 +270,8 @@ class ServeMetrics:
     def render_prometheus(self, queue_depth: int | None = None,
                           queued_tokens: int | None = None,
                           cache_stats: dict | None = None,
-                          slot_state: tuple[int, int] | None = None) -> str:
+                          slot_state: tuple[int, int] | None = None,
+                          degraded_rung: int | None = None) -> str:
         """``cache_stats`` is the backend's prefix_cache_stats() snapshot
         (evictions / blocks_used / blocks_total), read at scrape time like
         the queue gauges — the serving layer never mirrors pool state."""
@@ -268,6 +320,28 @@ class ServeMetrics:
         simple("cache_hit_rate", round(s.cache_hit_rate, 6))
         simple("inflight_segments_total", s.segments)
         simple("inflight_refills_total", s.refills)
+        typ, help_ = _METRICS["fault_failures_total"]
+        lines.append(f"# HELP {_PREFIX}fault_failures_total {help_}")
+        lines.append(f"# TYPE {_PREFIX}fault_failures_total {typ}")
+        # stable label set: every failure class renders, zeros included, so
+        # dashboards see series before the first failure of a class
+        from .supervisor import FailureClass
+
+        for cls in FailureClass:
+            lines.append(
+                f'{_PREFIX}fault_failures_total{{class="{cls.value}"}} '
+                f"{s.failures.get(cls.value, 0)}"
+            )
+        simple("fault_retries_total", s.retries)
+        simple("fault_bisects_total", s.bisects)
+        simple("fault_quarantined_total", s.quarantined)
+        simple("fault_backoff_seconds_total", round(s.backoff_seconds, 6))
+        simple("degraded_steps_total", s.degraded_steps)
+        simple("degraded_recoveries_total", s.degraded_recoveries)
+        if degraded_rung is not None:
+            # read from the live supervisor at scrape time, like the queue
+            # gauges — the metrics layer never mirrors ladder state
+            simple("degraded_rung", degraded_rung)
         if slot_state is not None:
             # (total, busy) read from the live slot loop at scrape time,
             # like the queue gauges — the metrics layer never mirrors it

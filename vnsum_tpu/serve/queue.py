@@ -33,17 +33,25 @@ class ShedReason(str, Enum):
     TOKEN_BUDGET = "token_budget"
     DEADLINE = "deadline"
     SHUTDOWN = "shutdown"
+    # graceful-degradation ladder bottom rung (serve/supervisor.py): the
+    # supervisor browned the server out after repeated resource-class
+    # failures — mapped to HTTP 503 + Retry-After, not 429
+    BROWNOUT = "brownout"
 
 
 class RequestShed(RuntimeError):
-    """Typed 429-style rejection: admission control or deadline shedding.
+    """Typed 429/503-style rejection: admission control, deadline shedding,
+    or supervisor brownout.
 
     Raised synchronously by submit() (admission) or delivered through the
     request future (deadline/shutdown shedding after the request was
-    admitted)."""
+    admitted). ``retry_after_s`` is the client backoff hint for brownout
+    sheds (the HTTP layer renders it as a Retry-After header)."""
 
-    def __init__(self, reason: ShedReason, detail: str = "") -> None:
+    def __init__(self, reason: ShedReason, detail: str = "",
+                 retry_after_s: float | None = None) -> None:
         self.reason = reason
+        self.retry_after_s = retry_after_s
         super().__init__(
             f"request shed ({reason.value})" + (f": {detail}" if detail else "")
         )
@@ -92,6 +100,10 @@ class ServeRequest:
     # trace at submit (no HTTP layer to finalize it) and must finish it on
     # completion
     own_trace: bool = False
+    # supervised-retry bookkeeping (serve/supervisor.py): how many FAILED
+    # engine dispatches this request has been part of; the supervisor's
+    # per-request retry budget caps it
+    attempts: int = 0
     enqueued_at: float = field(default_factory=time.monotonic)
     future: Future = field(default_factory=Future)
 
@@ -146,6 +158,12 @@ class RequestQueue:
         # request: counting the admit here means no scrape window where a
         # request is completed but not yet counted as submitted
         self.on_admit = None  # callable(req) | None — metrics hook
+        # supervisor brownout gate (serve/supervisor.py::admission_gate):
+        # callable() -> Retry-After seconds when the degradation ladder is
+        # shedding new work, None when admitting. Consulted for EXTERNAL
+        # submissions only — internal fan-out of already-admitted requests
+        # (force=True) must finish even under brownout
+        self.degraded = None
 
     # -- producer side ---------------------------------------------------
 
@@ -165,9 +183,9 @@ class RequestQueue:
             if req.expired():
                 self._shed_locked(req, ShedReason.DEADLINE)
             if not force:
-                reason = self._admission_reason_locked(req.billable_tokens)
-                if reason is not None:
-                    self._shed_locked(req, reason)
+                shed = self._admission_reason_locked(req.billable_tokens)
+                if shed is not None:
+                    self._shed_locked(req, shed[0], retry_after_s=shed[1])
             self._items.append(req)
             self._queued_tokens += req.billable_tokens
             if self.on_admit is not None:
@@ -175,17 +193,27 @@ class RequestQueue:
             self._cond.notify_all()
         return req.future
 
-    def _admission_reason_locked(self, est_tokens: int) -> ShedReason | None:
-        """The ONE depth/token-budget admission predicate — submit() and
-        check_admission() must never diverge on policy."""
+    def _admission_reason_locked(
+        self, est_tokens: int
+    ) -> tuple[ShedReason, float | None] | None:
+        """The ONE depth/token-budget/brownout admission predicate —
+        submit() and check_admission() must never diverge on policy.
+        Returns (reason, retry_after_s) or None. The degraded gate is
+        evaluated exactly ONCE per decision: it doubles as the supervisor's
+        recovery probe, so a second call could observe a different (healed)
+        ladder and desynchronize the shed from its Retry-After hint."""
+        if self.degraded is not None:
+            retry_after = self.degraded()
+            if retry_after is not None:
+                return ShedReason.BROWNOUT, retry_after
         if len(self._items) >= self.max_depth:
-            return ShedReason.QUEUE_FULL
+            return ShedReason.QUEUE_FULL, None
         if (
             self.max_queued_tokens
             and self._items  # an empty queue always admits one request
             and self._queued_tokens + est_tokens > self.max_queued_tokens
         ):
-            return ShedReason.TOKEN_BUDGET
+            return ShedReason.TOKEN_BUDGET, None
         return None
 
     def check_admission(self, est_tokens: int = 0) -> None:
@@ -196,14 +224,15 @@ class RequestQueue:
         with self._lock:
             if self._closed:
                 raise RequestShed(ShedReason.SHUTDOWN)
-            reason = self._admission_reason_locked(est_tokens)
-            if reason is not None:
-                raise RequestShed(reason)
+            shed = self._admission_reason_locked(est_tokens)
+            if shed is not None:
+                raise RequestShed(shed[0], retry_after_s=shed[1])
 
-    def _shed_locked(self, req: ServeRequest, reason: ShedReason):
+    def _shed_locked(self, req: ServeRequest, reason: ShedReason,
+                     retry_after_s: float | None = None):
         if self.on_shed is not None:
             self.on_shed(req, reason)
-        exc = RequestShed(reason)
+        exc = RequestShed(reason, retry_after_s=retry_after_s)
         # resolve the future too, for callers holding it (take-side sheds)
         if not req.future.done():
             req.future.set_exception(exc)
@@ -329,14 +358,29 @@ class RequestQueue:
         with self._cond:
             self._closed = True
             if not drain:
-                for r in self._items:
-                    self._queued_tokens -= r.billable_tokens
-                    if self.on_shed is not None:
-                        self.on_shed(r, ShedReason.SHUTDOWN)
-                    if not r.future.done():
-                        r.future.set_exception(RequestShed(ShedReason.SHUTDOWN))
-                self._items = []
+                self._shed_pending_locked()
             self._cond.notify_all()
+
+    def _shed_pending_locked(self) -> int:
+        n = len(self._items)
+        for r in self._items:
+            self._queued_tokens -= r.billable_tokens
+            if self.on_shed is not None:
+                self.on_shed(r, ShedReason.SHUTDOWN)
+            if not r.future.done():
+                r.future.set_exception(RequestShed(ShedReason.SHUTDOWN))
+        self._items = []
+        return n
+
+    def shed_pending(self) -> int:
+        """Fail every still-queued request with a typed SHUTDOWN shed —
+        the scheduler's drain-timeout escape hatch: when the engine thread
+        overruns its drain window, nothing may be left hanging on a future
+        nobody will ever resolve. Returns the number shed."""
+        with self._cond:
+            n = self._shed_pending_locked()
+            self._cond.notify_all()
+            return n
 
     def head_snapshot(self) -> tuple[tuple, float] | None:
         """(batch_key, enqueued_at) of the head-of-line request, or None —

@@ -27,12 +27,23 @@ queued request and waiting on the futures. Concurrent strategy runs (e.g.
 two /v1/summarize requests in flight) therefore interleave their map/collapse
 rounds into shared engine batches — re-entrant batch submission without the
 strategies knowing the serving layer exists.
+
+Fault tolerance (serve/supervisor.py, opt-in via ``supervisor=``; the HTTP
+server opts in by default): engine dispatch failures are classified
+(transient / resource-exhausted / poison / fatal), survivors retried under
+bounded jittered backoff with a per-request budget, crashing batches
+bisected to quarantine the poison request (typed RequestFailed on ITS
+future, everyone else completes), and repeated resource failures step a
+degradation ladder down (shrink batch -> no spec -> no cache inserts ->
+brownout) with probed recovery. Without a supervisor the pre-supervision
+contract holds: a failure resolves every rider with the raw error.
 """
 from __future__ import annotations
 
 import contextlib
 import threading
 import time
+from concurrent.futures import InvalidStateError
 
 from ..analysis.sanitizers import make_lock
 from ..backend.base import Backend
@@ -69,6 +80,7 @@ class MicroBatchScheduler:
         metrics: ServeMetrics | None = None,
         obs: ObsHub | None = None,
         trace_dir: str | None = None,
+        supervisor=None,
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -76,6 +88,22 @@ class MicroBatchScheduler:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.metrics = metrics or ServeMetrics()
+        # fault tolerance (serve/supervisor.py): None = pre-supervision
+        # contract — an engine failure resolves every rider with the raw
+        # error, no retries (what the direct-API tests pin). With a
+        # supervisor, dispatch failures are classified, survivors retried
+        # under backoff, poison requests bisected out, and repeated
+        # resource failures step the degradation ladder down
+        self.supervisor = supervisor
+        self._applied_rung = 0
+        # (t0, engine_s, bt) of the last FAILED dispatch attempt — written
+        # by _dispatch right before it raises, read by the resolvers.
+        # Scheduler-thread-only state, like the backend itself
+        self._attempt_ctx: tuple = (time.monotonic(), 0.0, None)
+        # the batch currently inside the engine (scheduler thread writes,
+        # close() snapshots on drain overrun so stuck dispatches still get
+        # typed SHUTDOWN sheds instead of hanging their futures)
+        self._dispatching: list[ServeRequest] | None = None
         # tracing hub (vnsum_tpu.obs): None = tracing fully off — the hot
         # path then pays only `is None` checks, no allocation, no contextvar
         # writes (the < 2% overhead guarantee in tests/test_obs_serve.py)
@@ -93,6 +121,12 @@ class MicroBatchScheduler:
         )
         self.queue.on_shed = self._on_shed
         self.queue.on_admit = lambda req: self.metrics.observe_submit()
+        if supervisor is not None:
+            # brownout gate: at the ladder's bottom rung new EXTERNAL
+            # admissions shed with a typed 503 + Retry-After; the gate call
+            # doubles as the recovery probe so an idle browned-out server
+            # still heals
+            self.queue.degraded = supervisor.admission_gate
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name="vnsum-serve-scheduler", daemon=True
@@ -241,10 +275,19 @@ class MicroBatchScheduler:
             self.obs.finish_request(req.trace, f"shed:{reason.value}")
             req.trace = None
 
+    def _take_limit(self) -> int:
+        """Engine dispatch width: the configured max_batch, halved by the
+        degradation ladder from REDUCED_BATCH down."""
+        if self.supervisor is not None:
+            return self.supervisor.batch_limit(self.max_batch)
+        return self.max_batch
+
     def _loop(self) -> None:
         while True:
             try:
-                batch = self.queue.take_batch(self.max_batch, self.max_wait_s)
+                batch = self.queue.take_batch(self._take_limit(),
+                                              self.max_wait_s)
+            # lint-allow[swallowed-exception]: a queue bug must not kill the scheduler thread; no request was taken, so there is no future to resolve
             except Exception:  # pragma: no cover - queue bugs must not kill serving
                 logger.exception("take_batch failed; scheduler continuing")
                 continue
@@ -263,7 +306,28 @@ class MicroBatchScheduler:
                         r.future.set_exception(e)
 
     def _run_batch(self, batch: list[ServeRequest]) -> None:
+        """One coalesced batch, end to end. With a supervisor configured,
+        dispatch failures go through classify -> retry/bisect -> typed
+        resolution (_run_supervised); without one, a failure resolves every
+        rider with the raw error — the pre-supervision contract."""
+        self._dispatching = batch
+        try:
+            if self.supervisor is None:
+                try:
+                    self._dispatch(batch)
+                except Exception as e:
+                    self._resolve_errored(batch, e, *self._attempt_ctx)
+                return
+            self._run_supervised(batch)
+        finally:
+            self._dispatching = None
+
+    def _dispatch(self, batch: list[ServeRequest]) -> None:
+        """One engine dispatch: resolves every future on success; on failure
+        records the attempt's batch metrics/trace, stashes (t0, engine_s,
+        bt) in ``_attempt_ctx`` for the resolvers, and raises."""
         head = batch[0]
+        self._attempt_ctx = (time.monotonic(), 0.0, None)
         # batch telemetry (vnsum_tpu.obs): the BatchTrace is installed as the
         # contextvar collector for the duration of backend.generate, so the
         # engine's prefill/decode/spec-step emits land on THIS batch's track
@@ -277,6 +341,11 @@ class MicroBatchScheduler:
             from ..core.profiling import device_profile
 
             profile_cm = device_profile(self._trace_dir)
+        references = [r.reference for r in batch]
+        if self.supervisor is not None and not self.supervisor.spec_enabled:
+            # ladder rung NO_SPEC: drop speculation references so the engine
+            # takes the plain decode path (greedy outputs are identical)
+            references = [None] * len(batch)
         token = set_collector(bt) if bt is not None else None
         t0 = time.monotonic()
         try:
@@ -285,16 +354,16 @@ class MicroBatchScheduler:
                     [r.prompt for r in batch],
                     max_new_tokens=head.max_new_tokens,
                     config=head.config,
-                    references=[r.reference for r in batch],
+                    references=references,
                     cache_hints=[r.cache_hint for r in batch],
                 )
-        except Exception as e:
+        except Exception:
             engine_s = time.monotonic() - t0
             self._finish_batch_trace(bt, 0)
             self.metrics.observe_batch(len(batch), engine_s)
             logger.exception("engine batch of %d failed", len(batch))
-            self._resolve_errored(batch, e, t0, engine_s, bt)
-            return
+            self._attempt_ctx = (t0, engine_s, bt)
+            raise
         finally:
             if token is not None:
                 reset_collector(token)
@@ -308,8 +377,8 @@ class MicroBatchScheduler:
             logger.error(str(e))
             self._finish_batch_trace(bt, 0)
             self.metrics.observe_batch(len(batch), engine_s)
-            self._resolve_errored(batch, e, t0, engine_s, bt)
-            return
+            self._attempt_ctx = (t0, engine_s, bt)
+            raise e
         gen_tokens = self.backend.count_tokens_batch(outs)
         self._finish_batch_trace(bt, sum(gen_tokens))
         self.metrics.observe_batch(len(batch), engine_s, sum(gen_tokens))
@@ -340,6 +409,177 @@ class MicroBatchScheduler:
             self._trace_request(r, t0, engine_s, bt, "ok")
             if not r.future.done():
                 r.future.set_result(_Completion(out, rec))
+
+    # -- supervision (serve/supervisor.py) --------------------------------
+
+    def _run_supervised(self, batch: list[ServeRequest]) -> None:
+        """Dispatch with recovery, entirely on the scheduler thread: every
+        path resolves every future. ``work`` is a stack of sub-batches —
+        retries and bisection halves go back on it until everything is
+        resolved (success, typed failure, or shed)."""
+        sup = self.supervisor
+        work: list[list[ServeRequest]] = [batch]
+        while work:
+            group = [r for r in work.pop() if not r.future.done()]
+            # deadline discipline survives retries: an expired rider is
+            # shed typed, never redispatched
+            now = time.monotonic()
+            for r in [r for r in group if r.expired(now)]:
+                self._shed_taken(r, ShedReason.DEADLINE)
+            group = [r for r in group if not r.expired(now)]
+            if not group:
+                continue
+            # ladder rung REDUCED_BATCH+: never dispatch wider than the
+            # degraded limit, even for batches taken before the step-down
+            limit = sup.batch_limit(self.max_batch)
+            if len(group) > limit:
+                work.append(group[limit:])
+                group = group[:limit]
+            self._apply_rung()
+            try:
+                self._dispatch(group)
+                sup.record_success()
+                self._apply_rung()
+            except Exception as e:
+                self._resolve_dispatch_failure(group, e, work)
+
+    def _resolve_dispatch_failure(
+        self, group: list[ServeRequest], e: Exception,
+        work: list[list[ServeRequest]],
+    ) -> None:
+        """Decide each rider's fate after one failed dispatch: fail typed
+        (fatal / out of budget / poisoned alone), bisect to isolate, or push
+        a backed-off retry onto ``work``."""
+        from .supervisor import FailureClass
+
+        sup = self.supervisor
+        cls = sup.classify(e)
+        self.metrics.observe_failure(cls.value)
+        sup.note_failure(cls)
+        self._apply_rung()
+        if cls is FailureClass.FATAL:
+            self._resolve_failed(group, e, cls)
+            return
+        if cls is FailureClass.POISON:
+            # deterministic input error: retrying burns device time. Alone,
+            # the request IS the poison — quarantine typed; in company,
+            # bisect so innocent riders escape through the clean half
+            if len(group) == 1:
+                self.metrics.observe_quarantine()
+                self._resolve_failed(group, e, cls)
+            else:
+                self._bisect(group, work)
+            return
+        # TRANSIENT / RESOURCE: charge the failed attempt to every rider
+        for r in group:
+            r.attempts += 1
+        budget = sup.policy.max_attempts
+        if any(r.attempts >= budget for r in group):
+            if len(group) > 1:
+                # the group burned its budget together — quarantine by
+                # bisection instead of failing innocents with the
+                # stranger's error
+                self._bisect(group, work)
+                return
+            # a lone request out of budget is terminal. A TRANSIENT-class
+            # error that failed every attempt, finally with no one else to
+            # blame, is the quarantine verdict; RESOURCE keeps its class
+            # (the operating point, not the request, is at fault)
+            final = (FailureClass.POISON if cls is FailureClass.TRANSIENT
+                     else cls)
+            if final is FailureClass.POISON:
+                self.metrics.observe_quarantine()
+            self._resolve_failed(group, e, final)
+            return
+        delay = sup.backoff_s(max(r.attempts for r in group))
+        self.metrics.observe_retry(len(group))
+        self.metrics.observe_backoff(delay)
+        for r in group:
+            self._trace_fault(r, "retry", cls.value, delay)
+        logger.warning(
+            "retrying batch of %d after %s failure (backoff %.3fs)",
+            len(group), cls.value, delay,
+        )
+        # the backoff sleeps the scheduler thread: queued healthy work waits
+        # it out too, which is deliberate — the engine just failed, and
+        # hammering it with the next batch is how failure storms start
+        time.sleep(delay)
+        work.append(group)
+
+    def _bisect(self, group: list[ServeRequest],
+                work: list[list[ServeRequest]]) -> None:
+        """Split a crashing batch to isolate its poison: halves re-dispatch
+        independently; the culprit bottoms out alone and fails typed while
+        every innocent rider escapes through a clean half."""
+        self.metrics.observe_bisect()
+        mid = len(group) // 2
+        logger.warning(
+            "bisecting crashing batch of %d to quarantine the fault",
+            len(group),
+        )
+        for r in group:
+            self._trace_fault(r, "bisect", None, 0.0)
+        work.append(group[mid:])
+        work.append(group[:mid])
+
+    def _resolve_failed(self, group, e, failure_class) -> None:
+        """Terminal typed failure: every rider's future gets RequestFailed
+        carrying the class and the last underlying error."""
+        from .supervisor import RequestFailed
+
+        t0, engine_s, bt = self._attempt_ctx
+        exc = RequestFailed(failure_class, detail=str(e), cause=e)
+        self._resolve_errored(group, exc, t0, engine_s, bt)
+
+    def _shed_taken(self, r: ServeRequest, reason: ShedReason) -> None:
+        """Typed shed for a request already taken off the queue (deadline
+        expiry at retry, drain overrun): metrics + owned-trace finalization
+        + the future, mirroring the queue-side shed hook."""
+        self.metrics.observe_shed(reason)
+        if r.own_trace and r.trace is not None and self.obs is not None:
+            self.obs.finish_request(r.trace, f"shed:{reason.value}")
+            r.trace = None
+        if not r.future.done():
+            try:
+                r.future.set_exception(RequestShed(reason))
+            # lint-allow[swallowed-exception]: losing the done()-check race means the scheduler thread resolved this future first — it is already answered, and the shed loop must keep going for the rest
+            except InvalidStateError:
+                pass
+
+    def _trace_fault(self, r: ServeRequest, event: str,
+                     failure_class: str | None, delay: float) -> None:
+        """Fault-path observability on the request's own timeline: one span
+        per retry/bisect so /debug/trace shows WHY a request's e2e latency
+        grew (class + attempt count + backoff)."""
+        tr = r.trace
+        if tr is None:
+            return
+        args = {"attempts": r.attempts}
+        if failure_class:
+            args["failure_class"] = failure_class
+        tr.add(f"fault_{event}", time.monotonic(), delay, r.trace_track,
+               **args)
+
+    def _apply_rung(self) -> None:
+        """Lazily apply ladder effects on the engine thread (the backend is
+        not thread-safe, so rung changes noted elsewhere take effect at the
+        next dispatch): prefix-cache insert gating, the step counters, and
+        the transition log line."""
+        sup = self.supervisor
+        rung = int(sup.rung)
+        if rung == self._applied_rung:
+            return
+        down = rung > self._applied_rung
+        for _ in range(abs(rung - self._applied_rung)):
+            self.metrics.observe_degraded(down)
+        logger.warning(
+            "degradation ladder: rung %d -> %d (%s)",
+            self._applied_rung, rung, "step-down" if down else "recovery",
+        )
+        self._applied_rung = rung
+        toggle = getattr(self.backend, "set_prefix_cache_inserts", None)
+        if callable(toggle):
+            toggle(sup.cache_inserts_enabled)
 
     def _resolve_errored(self, batch, e, t0, engine_s, bt) -> None:
         for r in batch:
@@ -402,12 +642,33 @@ class MicroBatchScheduler:
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop admitting; drain=True runs remaining queued batches to
-        completion before the scheduler thread exits."""
+        completion before the scheduler thread exits.
+
+        Drain overrun is not a warning-and-hang: when the scheduler thread
+        (stuck dispatch, fault storm) misses the window, every still-queued
+        AND currently-dispatching request gets a typed
+        RequestShed(SHUTDOWN) on its future — callers blocked on result()
+        unblock with the shed instead of hanging forever. The thread is a
+        daemon and every resolution site guards future.done(), so a late
+        engine completion is dropped harmlessly."""
         self._closed = True
         self.queue.close(drain=drain)
         self._thread.join(timeout=timeout)
-        if self._thread.is_alive():  # pragma: no cover - drain overrun
-            logger.warning("scheduler did not drain within %.1fs", timeout)
+        if self._thread.is_alive():
+            shed_queued = self.queue.shed_pending()
+            stranded = self._stranded_snapshot()
+            for r in stranded:
+                self._shed_taken(r, ShedReason.SHUTDOWN)
+            logger.warning(
+                "scheduler did not drain within %.1fs; shed %d queued and "
+                "%d in-flight request(s) with typed SHUTDOWN",
+                timeout, shed_queued, len(stranded),
+            )
+
+    def _stranded_snapshot(self) -> list[ServeRequest]:
+        """Requests taken off the queue but not yet resolved — what a drain
+        overrun must shed. The in-flight subclass adds its resident slots."""
+        return list(self._dispatching or [])
 
     @property
     def closed(self) -> bool:

@@ -55,8 +55,9 @@ from ..obs import ObsHub
 from ..obs.export import save_timestamped_trace
 from ..strategies import get_strategy
 from ..text import clean_thinking_tokens
-from .queue import RequestShed
+from .queue import RequestShed, ShedReason
 from .scheduler import MicroBatchScheduler
+from .supervisor import RequestFailed
 
 logger = get_logger("vnsum.serve.http")
 
@@ -81,8 +82,20 @@ class ServeState:
         inflight: bool = False,
         slots: int | None = None,
         slot_prompt_tokens: int = 0,
+        supervisor=None,
+        supervise: bool = True,
     ) -> None:
         self.backend = backend
+        # fault tolerance (serve/supervisor.py): ON by default for the HTTP
+        # front-end — engine failures are classified, survivors retried,
+        # poison requests bisected out, and repeated resource failures step
+        # the degradation ladder down to a typed 503 brownout. supervise=
+        # False (--no-supervise) restores the raw fail-the-batch contract
+        if supervisor is None and supervise:
+            from .supervisor import EngineSupervisor
+
+            supervisor = EngineSupervisor()
+        self.supervisor = supervisor
         # mirrors the backend's GenerationConfig(spec_k=...) default so a
         # request-built config (which REPLACES the backend default) keeps it
         self.default_spec_k = default_spec_k
@@ -107,6 +120,7 @@ class ServeState:
             max_queued_tokens=max_queued_tokens,
             obs=self.obs,
             trace_dir=trace_dir,
+            supervisor=supervisor,
         )
         if inflight:
             # in-flight batching (serve/inflight.py): slot-feeding over the
@@ -236,7 +250,8 @@ def make_handler(state: ServeState):
         # outcome (200, 429 shed, 500) so clients can always correlate
         _rid: str | None = None
 
-        def _json(self, payload: dict, status: int = 200) -> None:
+        def _json(self, payload: dict, status: int = 200,
+                  headers: dict | None = None) -> None:
             if self._rid is not None:
                 payload = {"request_id": self._rid, **payload}
             body = json.dumps(payload, ensure_ascii=False).encode()
@@ -244,9 +259,26 @@ def make_handler(state: ServeState):
             self.send_header("Content-Type", "application/json; charset=utf-8")
             if self._rid is not None:
                 self.send_header("X-Request-Id", self._rid)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _shed_response(self, e: RequestShed) -> None:
+            """The typed shed contract: admission/deadline sheds are 429;
+            a supervisor BROWNOUT is 503 with a Retry-After header — the
+            machine-readable 'back off, the server is degraded' signal."""
+            payload: dict = {"error": "shed", "reason": e.reason.value}
+            headers = None
+            status = 429
+            if e.reason is ShedReason.BROWNOUT:
+                status = 503
+                retry_after = e.retry_after_s or 1.0
+                payload["retry_after_s"] = retry_after
+                # Retry-After is delta-seconds, integral, at least 1
+                headers = {"Retry-After": str(max(1, int(round(retry_after))))}
+            self._json(payload, status, headers)
 
         def _text(self, body: str, status: int = 200) -> None:
             raw = body.encode()
@@ -279,15 +311,24 @@ def make_handler(state: ServeState):
                 self.end_headers()
                 self.wfile.write(body)
             elif path == "/healthz":
-                self._json(
-                    {
-                        "status": "ok",
-                        "backend": state.backend.name,
-                        "queue_depth": state.scheduler.queue.depth,
-                        "queued_tokens": state.scheduler.queue.queued_tokens,
-                        "closed": state.scheduler.closed,
-                    }
-                )
+                sup = state.supervisor
+                payload = {
+                    "status": "ok",
+                    "backend": state.backend.name,
+                    "queue_depth": state.scheduler.queue.depth,
+                    "queued_tokens": state.scheduler.queue.queued_tokens,
+                    "closed": state.scheduler.closed,
+                }
+                if sup is not None:
+                    # the degradation ladder is health surface: "ok" only
+                    # at HEALTHY, "degraded" on any lower rung so probes
+                    # and load balancers see the brownout coming
+                    rung = sup.rung
+                    payload["degraded_rung"] = int(rung)
+                    payload["degraded"] = rung.name.lower()
+                    if rung > 0:
+                        payload["status"] = "degraded"
+                self._json(payload)
             elif path == "/metrics":
                 cache_stats = getattr(
                     state.backend, "prefix_cache_stats", lambda: None
@@ -301,6 +342,10 @@ def make_handler(state: ServeState):
                         queued_tokens=state.scheduler.queue.queued_tokens,
                         cache_stats=cache_stats,
                         slot_state=slot_state,
+                        degraded_rung=(
+                            int(state.supervisor.rung)
+                            if state.supervisor is not None else None
+                        ),
                     )
                 )
             else:
@@ -314,6 +359,7 @@ def make_handler(state: ServeState):
         def _read_json(self) -> dict | None:
             try:
                 length = int(self.headers.get("Content-Length", "0"))
+            # lint-allow[swallowed-exception]: a garbled header becomes length=-1, which the branch below answers with a typed 400
             except ValueError:
                 length = -1
             if length < 0 or length > self.MAX_BODY_BYTES:
@@ -420,7 +466,17 @@ def make_handler(state: ServeState):
             except RequestShed as e:
                 if state.obs is not None:
                     state.obs.finish_request(trace, f"shed:{e.reason.value}")
-                self._json({"error": "shed", "reason": e.reason.value}, 429)
+                self._shed_response(e)
+                return
+            except RequestFailed as e:
+                # supervision gave up: typed terminal failure (poison
+                # quarantine, exhausted retries, fatal engine error)
+                if state.obs is not None:
+                    state.obs.finish_request(trace, "error")
+                logger.exception("generate failed after supervision")
+                self._json({"error": "request_failed",
+                            "class": e.failure_class.value,
+                            "detail": str(e)}, 500)
                 return
             except Exception as e:  # engine failure: surface, don't crash
                 if state.obs is not None:
@@ -489,7 +545,15 @@ def make_handler(state: ServeState):
             except RequestShed as e:
                 if state.obs is not None:
                     state.obs.finish_request(trace, f"shed:{e.reason.value}")
-                self._json({"error": "shed", "reason": e.reason.value}, 429)
+                self._shed_response(e)
+                return
+            except RequestFailed as e:
+                if state.obs is not None:
+                    state.obs.finish_request(trace, "error")
+                logger.exception("summarize failed after supervision")
+                self._json({"error": "request_failed",
+                            "class": e.failure_class.value,
+                            "detail": str(e)}, 500)
                 return
             except Exception as e:
                 if state.obs is not None:
@@ -577,6 +641,16 @@ def main(argv: list[str] | None = None) -> int:
                    help="tokens per prefix-cache block (reuse granularity)")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable the prefix KV cache outright")
+    p.add_argument("--no-supervise", action="store_true",
+                   help="disable engine supervision (retry/bisect/"
+                        "degradation ladder); failures fail the whole batch "
+                        "with the raw error")
+    p.add_argument("--retry-max-attempts", type=int, default=3,
+                   help="supervised retry budget: failed dispatches one "
+                        "request may ride before it stops being retried")
+    p.add_argument("--probe-interval-ms", type=float, default=5000.0,
+                   help="degradation ladder: quiet time before a recovery "
+                        "probe climbs one rung back up")
     p.add_argument("--trace-sample", type=float, default=1.0,
                    help="fraction of requests recorded into the /debug/trace "
                         "ring (0 disables tracing entirely; histograms on "
@@ -612,8 +686,18 @@ def main(argv: list[str] | None = None) -> int:
             "fake", spec_k=args.spec_k, prefix_cache_blocks=cache_blocks
         )
 
+    supervisor = None
+    if not args.no_supervise:
+        from .supervisor import EngineSupervisor, RetryPolicy
+
+        supervisor = EngineSupervisor(
+            RetryPolicy(max_attempts=args.retry_max_attempts),
+            probe_interval_s=args.probe_interval_ms / 1000.0,
+        )
     state = ServeState(
         backend,
+        supervisor=supervisor,
+        supervise=not args.no_supervise,
         max_batch=args.max_batch,
         max_wait_s=args.max_wait_ms / 1000.0,
         max_queue_depth=args.max_queue,
@@ -637,6 +721,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     try:
         server.serve_forever()
+    # lint-allow[swallowed-exception]: Ctrl-C IS the shutdown request; the finally below drains the queue and resolves every future
     except KeyboardInterrupt:
         pass
     finally:

@@ -1,0 +1,31 @@
+"""Deterministic test instrumentation baked into the runtime.
+
+Unlike ``tests/`` (which consumes the framework), this package is part of
+the shipped tree so production code can carry permanently-wired, zero-cost
+hooks — today, the seeded fault-injection plan (:mod:`faults`) that the
+backend dispatch sites call into. Nothing here imports jax or the serving
+layer, so arming a plan can never change what gets compiled.
+"""
+from .faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    InjectedResourceExhausted,
+    arm,
+    disarm,
+    fault,
+    injected,
+    plan_from_env,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "InjectedResourceExhausted",
+    "arm",
+    "disarm",
+    "fault",
+    "injected",
+    "plan_from_env",
+]
