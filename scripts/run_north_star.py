@@ -30,6 +30,8 @@ sys.path.insert(0, str(REPO))
 
 REFERENCE_SUMMARIZE_MIN = 50.0  # BASELINE.md: reference full-eval summarize
 
+from vnsum_tpu.core.artifacts import atomic_write_json  # noqa: E402
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -277,7 +279,7 @@ def main() -> int:
         rec["approaches"] = per_approach
         rec["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
         Path(args.out).parent.mkdir(parents=True, exist_ok=True)
-        Path(args.out).write_text(json.dumps(rec, indent=2))
+        atomic_write_json(args.out, rec)
         gc.collect()
 
     # script-owned provenance: a partial rerun must never drop the
@@ -314,7 +316,7 @@ def main() -> int:
                 "projects from this with the MULTICHIP dryrun's DP scaling"
             ),
         }
-    Path(args.out).write_text(json.dumps(rec, indent=2))
+    atomic_write_json(args.out, rec)
     print(json.dumps({"ok": True, "headline": rec.get("headline"),
                       "approaches": {
                           k: v["wall_minutes"] for k, v in per_approach.items()

@@ -425,3 +425,85 @@ def test_swallowed_exception_suppression_and_scope(tmp_path):
     f.write_text(textwrap.dedent(SWALLOWED_SRC), encoding="utf-8")
     assert run_paths([f], root=tmp_path,
                      rules=["swallowed-exception"]) == []
+
+
+# -- durable-write -----------------------------------------------------------
+
+
+DURABLE_GOOD = """
+    import os
+    import tempfile
+
+    # durable
+    def atomic_write(path, text):
+        fd, tmp = tempfile.mkstemp(dir=".")
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+"""
+
+DURABLE_MISSING_FSYNC = """
+    import os
+    import tempfile
+
+    def caller(path, text):  # unmarked helper: not checked
+        open(path, "w").write(text)
+
+    # durable
+    def sloppy_write(path, text):
+        fd, tmp = tempfile.mkstemp(dir=".")
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+        os.replace(tmp, path)
+"""
+
+DURABLE_APPEND_ONLY = """
+    import os
+
+    # durable: compaction-style rewrite
+    def rewrite(path, lines):
+        with open(path + ".tmp", "wb") as f:
+            f.writelines(lines)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(path + ".tmp", path)
+
+    def plain_append(f, line):  # no marker, no sequence required
+        f.write(line)
+"""
+
+
+def test_durable_write_full_sequence_is_clean(tmp_path):
+    assert lint(tmp_path, DURABLE_GOOD, rules=["durable-write"]) == []
+    assert lint(tmp_path, DURABLE_APPEND_ONLY, rules=["durable-write"]) == []
+
+
+def test_durable_write_flags_missing_op_and_names_it(tmp_path):
+    findings = lint(tmp_path, DURABLE_MISSING_FSYNC, rules=["durable-write"])
+    assert len(findings) == 1
+    assert findings[0].rule == "durable-write"
+    assert "sloppy_write" in findings[0].message
+    assert "fsync" in findings[0].message
+    # the unmarked sloppy caller is out of scope by design
+    assert "caller" not in findings[0].message
+
+
+def test_durable_write_suppression_with_reason_clears(tmp_path):
+    src = DURABLE_MISSING_FSYNC.replace(
+        "# durable",
+        "# durable\n    # lint-allow[durable-write]: fixture exercises suppression",
+    )
+    assert lint(tmp_path, src, rules=["durable-write"]) == []
+
+
+def test_durable_write_marker_must_be_the_word(tmp_path):
+    # prose that merely mentions durability must not arm the check
+    src = """
+    def notes():
+        # durability is handled by the caller via atomic_write
+        return 1
+    """
+    assert lint(tmp_path, src, rules=["durable-write"]) == []

@@ -1,0 +1,444 @@
+"""Durable serving: write-ahead journal record properties (CRC, torn
+tails, rotation under concurrent writers, replay idempotence), scheduler
+lifecycle integration, restart replay byte-identity, the HTTP poll
+surface, and the chaos helpers' seeded determinism."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vnsum_tpu.backend.fake import FakeBackend
+from vnsum_tpu.serve.journal import RequestJournal, _encode
+from vnsum_tpu.serve.queue import RequestShed, ServeRequest
+from vnsum_tpu.serve.scheduler import MicroBatchScheduler
+from vnsum_tpu.serve.server import ServeState, make_server
+from vnsum_tpu.testing.chaos import KillSchedule, free_port
+
+
+def _req(prompt="văn bản cần tóm tắt " * 8, trace_id="t-1", **kw):
+    return ServeRequest(prompt=prompt, trace_id=trace_id, **kw)
+
+
+def _segments(directory):
+    return sorted(directory.glob("journal.*.jsonl"))
+
+
+# -- record / recovery properties -------------------------------------------
+
+
+def test_lifecycle_roundtrip_and_reopen(tmp_path):
+    j = RequestJournal(tmp_path)
+    rid = j.accept(_req(trace_id="a"))
+    assert rid == "a"
+    j.start(rid)
+    j.complete(rid, "kết quả tóm tắt", gen_tokens=3)
+    rid2 = j.accept(_req(trace_id="b"))
+    j.fail(rid2, "shed:deadline", "expired")
+    j.close()  # no seal: simulated crash
+
+    j2 = RequestJournal(tmp_path)
+    (a,) = j2.lookup("a")
+    assert a.status == "complete" and a.text == "kết quả tóm tắt"
+    assert a.gen_tokens == 3
+    (b,) = j2.lookup("b")
+    assert b.status == "failed" and b.reason == "shed:deadline"
+    assert j2.pending() == 0 and not j2.recovered_sealed
+    j2.close()
+
+
+def test_fanout_rids_and_lookup_children(tmp_path):
+    j = RequestJournal(tmp_path)
+    rids = [j.accept(_req(trace_id="req")) for _ in range(3)]
+    assert rids == ["req", "req#1", "req#2"]
+    assert {e.rid for e in j.lookup("req")} == set(rids)
+    # a different trace never leaks into the prefix match
+    j.accept(_req(trace_id="req2"))
+    assert {e.rid for e in j.lookup("req")} == set(rids)
+    j.close()
+
+
+def test_crc_rejects_torn_tail(tmp_path):
+    j = RequestJournal(tmp_path)
+    j.accept(_req(trace_id="keep"))
+    j.complete("keep", "done")
+    j.accept(_req(trace_id="torn"))
+    j.close()
+    # tear the last record mid-line, like a kill mid-write leaves it
+    (seg,) = _segments(tmp_path)
+    data = seg.read_bytes()
+    seg.write_bytes(data[:-17])
+
+    entries, sealed, torn = RequestJournal.read_state(tmp_path)
+    assert torn == 1
+    assert "torn" not in entries  # the torn ACCEPT is dropped, not garbage
+    assert entries["keep"].status == "complete"
+
+
+def test_crc_rejects_corrupt_record_and_stops_trusting_segment(tmp_path):
+    j = RequestJournal(tmp_path)
+    for t in ("a", "b", "c"):
+        j.accept(_req(trace_id=t))
+    j.close()
+    (seg,) = _segments(tmp_path)
+    lines = seg.read_bytes().splitlines(keepends=True)
+    # flip a byte inside record b's JSON body: CRC must catch it and the
+    # reader must stop trusting everything after it in this segment
+    lines[1] = lines[1][:15] + b"X" + lines[1][16:]
+    seg.write_bytes(b"".join(lines))
+
+    entries, _sealed, torn = RequestJournal.read_state(tmp_path)
+    assert torn == 1
+    assert set(entries) == {"a"}
+
+
+def test_sealed_journal_compacts_on_reopen(tmp_path):
+    j = RequestJournal(tmp_path, max_segment_bytes=400)
+    for i in range(8):
+        rid = j.accept(_req(trace_id=f"r{i}"))
+        j.complete(rid, f"out-{i}")
+    assert j.rotations > 0 and len(_segments(tmp_path)) > 1
+    j.seal()
+    j.close()
+
+    j2 = RequestJournal(tmp_path)
+    assert j2.recovered_sealed
+    # compaction rewrote live state into ONE fresh segment (atomically)
+    assert len(_segments(tmp_path)) == 1
+    for i in range(8):
+        (e,) = j2.lookup(f"r{i}")
+        assert e.status == "complete" and e.text == f"out-{i}"
+    j2.close()
+
+
+def test_rotation_under_concurrent_writers(tmp_path):
+    j = RequestJournal(tmp_path, max_segment_bytes=2048)
+    n_threads, per_thread = 6, 40
+    errors = []
+
+    def writer(t):
+        try:
+            for i in range(per_thread):
+                rid = j.accept(_req(trace_id=f"w{t}-{i}"))
+                j.start(rid)
+                j.complete(rid, f"text-{t}-{i}")
+        except Exception as e:  # pragma: no cover - the assertion below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert j.rotations > 0  # the property under test actually exercised
+    j.close()
+
+    # every record survives rotation, exactly once, with its final state
+    entries, _sealed, torn = RequestJournal.read_state(tmp_path)
+    assert torn == 0
+    assert len(entries) == n_threads * per_thread
+    for t in range(n_threads):
+        for i in range(per_thread):
+            e = entries[f"w{t}-{i}"]
+            assert e.status == "complete" and e.text == f"text-{t}-{i}"
+
+
+def test_accept_is_idempotent_per_rid(tmp_path):
+    j = RequestJournal(tmp_path)
+    req = _req(trace_id="once")
+    j.accept(req)
+    before = j.records
+    # replay resubmission path: journal_rid preset -> no duplicate ACCEPT
+    j.accept(req)
+    assert j.records == before
+    assert len(j.lookup("once")) == 1
+    j.close()
+
+
+def test_take_unfinished_hands_each_entry_out_once(tmp_path):
+    j = RequestJournal(tmp_path)
+    j.accept(_req(trace_id="u1"))
+    j.accept(_req(trace_id="u2"))
+    rid = j.accept(_req(trace_id="done"))
+    j.complete(rid, "x")
+    j.close()
+
+    j2 = RequestJournal(tmp_path)
+    first = {e.rid for e in j2.take_unfinished()}
+    assert first == {"u1", "u2"}
+    # replaying twice enqueues once: the second take returns nothing
+    assert j2.take_unfinished() == []
+    j2.close()
+
+
+def test_terminal_eviction_keeps_unfinished_and_bounds_history(tmp_path):
+    j = RequestJournal(tmp_path, keep_terminal=5)
+    j.accept(_req(trace_id="open"))
+    for i in range(12):
+        rid = j.accept(_req(trace_id=f"d{i}"))
+        j.complete(rid, "x")
+    assert j.pending() == 1  # the open entry is never evicted
+    assert len(j.lookup("open")) == 1
+    assert sum(1 for i in range(12) if j.lookup(f"d{i}")) <= 5
+    j.close()
+
+
+def test_torn_tail_then_append_continues_cleanly(tmp_path):
+    """A recovered-then-compacted journal is immediately writable and the
+    pre-tear state survives the next generation too."""
+    j = RequestJournal(tmp_path)
+    j.accept(_req(trace_id="old"))
+    j.close()
+    (seg,) = _segments(tmp_path)
+    seg.write_bytes(seg.read_bytes() + b"deadbeef {torn")  # garbage tail
+
+    j2 = RequestJournal(tmp_path)
+    assert j2.torn_records == 1
+    rid = j2.accept(_req(trace_id="new"))
+    j2.complete(rid, "ok")
+    j2.seal()
+    j2.close()
+    entries, sealed, torn = RequestJournal.read_state(tmp_path)
+    assert sealed and torn == 0  # compaction dropped the garbage for good
+    assert set(entries) == {"old", "new"}
+
+
+# -- scheduler integration ---------------------------------------------------
+
+
+def test_scheduler_journals_full_lifecycle(tmp_path):
+    j = RequestJournal(tmp_path)
+    sched = MicroBatchScheduler(FakeBackend(), max_batch=4, max_wait_s=0.005,
+                                journal=j)
+    fut = sched.submit("nội dung " * 10, trace_id="life")
+    out = fut.result(timeout=10)
+    sched.close()
+    (e,) = j.lookup("life")
+    assert e.status == "complete" and e.text == out.text
+    j.close()
+
+
+def test_scheduler_journals_engine_failure_typed(tmp_path):
+    j = RequestJournal(tmp_path)
+
+    class Exploding(FakeBackend):
+        def generate(self, prompts, **kw):
+            raise RuntimeError("engine down")
+
+    sched = MicroBatchScheduler(Exploding(), max_batch=4, max_wait_s=0.005,
+                                journal=j)
+    fut = sched.submit("x " * 5, trace_id="boom")
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=10)
+    sched.close()
+    (e,) = j.lookup("boom")
+    assert e.status == "failed" and e.reason == "error"
+    j.close()
+
+
+def test_queue_shed_of_admitted_request_is_journaled_failed(tmp_path):
+    j = RequestJournal(tmp_path)
+    slow = FakeBackend(batch_overhead_s=0.2)
+    sched = MicroBatchScheduler(slow, max_batch=1, max_wait_s=0.0, journal=j)
+    # head occupies the engine; the second request's deadline expires queued
+    f1 = sched.submit("đầu " * 5, trace_id="head")
+    f2 = sched.submit("hết hạn " * 5, trace_id="late",
+                      deadline=time.monotonic() + 0.05)
+    with pytest.raises(RequestShed):
+        f2.result(timeout=10)
+    f1.result(timeout=10)
+    sched.close()
+    (e,) = j.lookup("late")
+    assert e.status == "failed" and e.reason == "shed:deadline"
+    j.close()
+
+
+def test_admission_shed_is_never_journaled(tmp_path):
+    j = RequestJournal(tmp_path)
+    slow = FakeBackend(batch_overhead_s=0.2)
+    sched = MicroBatchScheduler(slow, max_batch=1, max_wait_s=0.0,
+                                max_queue_depth=1, journal=j)
+    f1 = sched.submit("a " * 5, trace_id="in")
+    time.sleep(0.05)  # f1 is now inside the 0.2s engine dispatch
+    f2 = sched.submit("b " * 5, trace_id="queued")  # fills the depth-1 queue
+    with pytest.raises(RequestShed):
+        # never accepted -> the ledger owes it nothing (the client got a
+        # synchronous typed 429; at-least-once starts at ACCEPT)
+        sched.submit("c " * 5, trace_id="shed-me")
+    f1.result(timeout=10)
+    f2.result(timeout=10)
+    sched.close()
+    j.close()
+    entries, _, _ = RequestJournal.read_state(tmp_path)
+    assert {"in", "queued"} <= set(entries)
+    assert "shed-me" not in entries
+
+
+# -- restart replay ----------------------------------------------------------
+
+
+def test_restart_replays_unfinished_byte_identically(tmp_path):
+    prompt = "văn bản dang dở cần phát lại " * 6
+    # life 1: accept lands in the journal, process "dies" before dispatch
+    j = RequestJournal(tmp_path)
+    j.accept(_req(prompt=prompt, trace_id="replay-me"))
+    j.close()  # crash: no terminal record, no seal
+
+    # life 2: ServeState replays through the normal path
+    state = ServeState(FakeBackend(), max_batch=4, max_wait_s=0.005,
+                       trace_sample=0.0, journal_dir=str(tmp_path))
+    assert state.replay_journal() == 1
+    t_end = time.monotonic() + 10
+    while state.journal.pending() and time.monotonic() < t_end:
+        time.sleep(0.01)
+    (e,) = state.journal.lookup("replay-me")
+    assert e.status == "complete"
+    # byte-identity: the replayed output equals an uninterrupted run's
+    assert e.text == FakeBackend().generate([prompt])[0]
+    # idempotence at the state level: a second replay enqueues nothing
+    assert state.replay_journal() == 0
+    state.close()
+
+
+def test_replay_restores_config_and_expires_stale_deadlines(tmp_path):
+    from vnsum_tpu.core.config import GenerationConfig
+
+    j = RequestJournal(tmp_path)
+    cfg = GenerationConfig(temperature=0.0, seed=123, top_k=4)
+    j.accept(_req(prompt="có cấu hình " * 5, trace_id="cfg",
+                  config=cfg))
+    j.accept(_req(prompt="đã hết hạn " * 5, trace_id="stale",
+                  deadline=time.monotonic() - 1.0))
+    j.close()
+
+    state = ServeState(FakeBackend(), max_batch=4, max_wait_s=0.005,
+                       trace_sample=0.0, journal_dir=str(tmp_path))
+    assert state.replay_journal() == 1  # the stale one fails without enqueue
+    (stale,) = state.journal.lookup("stale")
+    assert stale.status == "failed" and stale.reason == "shed:deadline"
+    t_end = time.monotonic() + 10
+    while state.journal.pending() and time.monotonic() < t_end:
+        time.sleep(0.01)
+    (e,) = state.journal.lookup("cfg")
+    assert e.status == "complete"
+    state.close()
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+@pytest.fixture()
+def journal_serve(tmp_path):
+    state = ServeState(FakeBackend(), max_batch=8, max_wait_s=0.005,
+                       trace_sample=0.0, journal_dir=str(tmp_path))
+    server = make_server(state, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}", state
+    server.shutdown()
+    server.server_close()
+    state.close()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_poll_endpoint_serves_journaled_result(journal_serve):
+    base, state = journal_serve
+    status, d = _post(base + "/v1/generate",
+                      {"prompt": "xin chào " * 10, "request_id": "poll-me"})
+    assert status == 200
+    text = d["completions"][0]["text"]
+    status, d = _get(base + "/v1/requests/poll-me")
+    assert status == 200
+    assert d["status"] == "completed"
+    assert d["entries"][0]["text"] == text
+
+
+def test_poll_unknown_id_is_404(journal_serve):
+    base, _ = journal_serve
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(base + "/v1/requests/never-seen")
+    assert exc.value.code == 404
+
+
+def test_poll_without_journal_is_404():
+    state = ServeState(FakeBackend(), max_batch=4, max_wait_s=0.005,
+                       trace_sample=0.0)
+    server = make_server(state, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(base + "/v1/requests/x")
+        assert exc.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+        state.close()
+
+
+def test_journal_metrics_rendered(journal_serve):
+    base, state = journal_serve
+    _post(base + "/v1/generate", {"prompt": "đo lường " * 8})
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    assert "vnsum_serve_journal_records_total" in text
+    assert "vnsum_serve_journal_pending 0" in text
+
+
+def test_inflight_scheduler_journals_slot_completions(tmp_path):
+    j = RequestJournal(tmp_path)
+    state = ServeState(
+        FakeBackend(segment_words=4), max_batch=4, max_wait_s=0.005,
+        trace_sample=0.0, inflight=True,
+    )
+    # swap the journal in (ServeState builds from journal_dir; here we hand
+    # the scheduler one directly to keep the in-flight path isolated)
+    state.scheduler.journal = j
+    fut = state.scheduler.submit("từng đoạn " * 12, trace_id="slots")
+    out = fut.result(timeout=10)
+    state.close()
+    (e,) = j.lookup("slots")
+    assert e.status == "complete" and e.text == out.text
+    j.close()
+
+
+# -- chaos helpers -----------------------------------------------------------
+
+
+def test_kill_schedule_is_seeded_and_covers_required_kinds():
+    a = KillSchedule(seed=7, kills=3)
+    b = KillSchedule(seed=7, kills=3)
+    assert a.describe() == b.describe()  # replayable from the seed
+    kinds = {p.kind for p in a.points}
+    assert kinds == {"mid_load", "mid_drain"}
+    assert KillSchedule(seed=8, kills=3).describe() != a.describe()
+
+
+def test_free_port_binds():
+    port = free_port()
+    assert 0 < port < 65536
+
+
+def test_encode_lines_are_newline_framed():
+    raw = _encode({"e": "accept", "rid": "x", "prompt": "có dấu ư"})
+    assert raw.endswith(b"\n") and raw[8:9] == b" "
+    assert b"\n" not in raw[:-1]  # one record, one line — framing invariant

@@ -202,3 +202,92 @@ def test_unknown_routes_404(serve_url):
     with pytest.raises(urllib.error.HTTPError) as exc:
         _post(base + "/v1/nope", {})
     assert exc.value.code == 404
+
+
+# -- malformed-body hardening (typed 400s, never the 500 engine path) --------
+
+
+def _raw_post(base, path, body: bytes, content_length: int | None = None):
+    """POST with full control over the bytes and the Content-Length header
+    (urllib always sets a correct length, which several of these cases must
+    violate on purpose). Returns (status, parsed-or-raw body)."""
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlparse(base)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=30)
+    try:
+        conn.putrequest("POST", path)
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader(
+            "Content-Length",
+            str(len(body) if content_length is None else content_length),
+        )
+        conn.endheaders()
+        conn.send(body)
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, raw
+    finally:
+        conn.close()
+
+
+def test_invalid_utf8_body_is_400(serve_url):
+    # json.loads raises UnicodeDecodeError (not JSONDecodeError) here; an
+    # uncaught one used to surface as a 500
+    base, _ = serve_url
+    status, body = _raw_post(base, "/v1/generate", b'{"prompt": "\xff\xfe"}')
+    assert status == 400
+    assert "UTF-8" in body["error"]
+
+
+def test_invalid_json_body_is_400(serve_url):
+    base, _ = serve_url
+    status, body = _raw_post(base, "/v1/generate", b'{"prompt": "x"')
+    assert status == 400
+    assert body["error"] == "invalid JSON"
+
+
+def test_oversized_declared_body_is_413_typed(serve_url):
+    # refused on the DECLARED length, before buffering a byte
+    base, _ = serve_url
+    status, body = _raw_post(
+        base, "/v1/generate", b"{}", content_length=64 * 1024 * 1024
+    )
+    assert status == 413
+    assert body["error"] == "request body too large"
+
+
+def test_unknown_fields_are_400_with_the_field_named(serve_url):
+    base, state = serve_url
+    for path, payload in (
+        ("/v1/generate", {"prompt": "x " * 4, "temperatre": 0.5}),
+        ("/v1/summarize", {"text": "x " * 4, "aproach": "mapreduce"}),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base + path, payload)
+        assert exc.value.code == 400
+        err = json.loads(exc.value.read())
+        assert "unknown field" in err["error"]
+    # a typo'd knob must never have reached the engine as a silent default
+    assert state.scheduler.metrics.snapshot().errors == 0
+
+
+def test_all_documented_fields_still_accepted(serve_url):
+    # the allowlist must not reject anything the API documents
+    base, _ = serve_url
+    status, d = _post(base + "/v1/generate", {
+        "prompt": "đầy đủ " * 6, "max_new_tokens": 16, "temperature": 0.0,
+        "top_k": 1, "top_p": 1.0, "seed": 3, "spec_k": 0,
+        "deadline_ms": 30000, "request_id": "full-1",
+        "reference": "tham khảo", "cache_hint": "đầy đủ",
+    })
+    assert status == 200 and d["completions"][0]["text"]
+    status, d = _post(base + "/v1/summarize", {
+        "text": DOC, "approach": "truncated", "max_new_tokens": 32,
+        "deadline_ms": 60000, "request_id": "full-2",
+    })
+    assert status == 200 and d["summary"]

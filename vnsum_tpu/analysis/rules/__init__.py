@@ -3,6 +3,7 @@
 ship a new rule."""
 from . import (  # noqa: F401
     donation,
+    durable,
     guarded_by,
     host_sync,
     metrics_doc,

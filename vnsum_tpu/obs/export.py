@@ -103,11 +103,13 @@ def spans_to_chrome(spans, process_name: str = "pipeline") -> dict:
 def save_chrome_trace(trace: dict, path) -> Path:
     """Write a trace dict as .json next to any XLA device traces
     (`core/profiling.device_profile` writes into the same directory when
-    armed), so host spans and device timelines open side by side."""
-    p = Path(path)
-    p.parent.mkdir(parents=True, exist_ok=True)
-    p.write_text(json.dumps(trace), encoding="utf-8")
-    return p
+    armed), so host spans and device timelines open side by side.
+    Atomic (write-temp + os.replace, core/artifacts.py): the shutdown dump
+    path runs while the process is dying — a crash mid-dump must not leave
+    a truncated JSON the next Perfetto load chokes on."""
+    from ..core.artifacts import atomic_write_text
+
+    return atomic_write_text(Path(path), json.dumps(trace))
 
 
 def save_timestamped_trace(trace: dict, directory, prefix: str) -> Path:

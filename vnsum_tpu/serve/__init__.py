@@ -26,6 +26,11 @@ This package is the missing online front-end for the batched engine:
                 quarantines poison requests, and the graceful-degradation
                 ladder (shrink batch -> no spec -> no cache inserts ->
                 typed 503 brownout, with recovery probes)
+- journal.py    durability: write-ahead request journal (CRC-checked JSONL
+                segments, group-commit fsync, atomic compaction) — every
+                accepted request is journaled before engine work, outcomes
+                append COMPLETE/typed-FAILED, and a restart replays the
+                unfinished remainder byte-identically (--journal-dir)
 - metrics.py    per-request + aggregate observability: counters, rolling
                 gauges, and fixed-bucket histograms (queue wait / TTFT /
                 e2e / occupancy / accepted-per-step) in Prometheus text;
@@ -41,6 +46,7 @@ thread-safe), and concurrency lives entirely in front of it.
 from .queue import RequestQueue, RequestShed, ServeRequest, ShedReason
 from .scheduler import MicroBatchScheduler, QueuedBackend
 from .inflight import InflightScheduler
+from .journal import JournalEntry, RequestJournal
 from .metrics import ServeMetrics
 from .supervisor import (
     EngineSupervisor,
@@ -56,7 +62,9 @@ __all__ = [
     "FailureClass",
     "FatalEngineError",
     "InflightScheduler",
+    "JournalEntry",
     "MicroBatchScheduler",
+    "RequestJournal",
     "QueuedBackend",
     "RequestFailed",
     "RequestQueue",

@@ -209,6 +209,7 @@ class InflightScheduler(MicroBatchScheduler):
             )
             self.metrics.observe_request(rec)
             self._trace_request(r, t0, max(now - t0, 0.0), None, "error")
+            self._journal_fail(r, "error", str(e))
             if not r.future.done():
                 r.future.set_exception(e)
 
@@ -288,6 +289,11 @@ class InflightScheduler(MicroBatchScheduler):
         for adm in admissions:
             r: ServeRequest = adm.key
             r.inflight_admission = adm  # read back at harvest
+            if self.journal is not None and r.journal_rid is not None:
+                # slot admission IS this request's engine start: its own
+                # prefill ran (the one-shot path journals START per batch
+                # dispatch in _dispatch instead)
+                self.journal.start(r.journal_rid)
         if admissions:
             prefill_s = admissions[0].prefill_end - admissions[0].admitted_at
             self.metrics.observe_batch(len(admissions), prefill_s)
@@ -344,5 +350,9 @@ class InflightScheduler(MicroBatchScheduler):
             )
             self.metrics.observe_request(rec)
             self._trace_request(r, t_admit, engine_s, None, "ok")
+            if self.journal is not None and r.journal_rid is not None:
+                # ledger before future, same ordering rationale as the
+                # one-shot path in scheduler._dispatch
+                self.journal.complete(r.journal_rid, c.text, c.gen_tokens)
             if not r.future.done():
                 r.future.set_result(_Completion(c.text, rec))

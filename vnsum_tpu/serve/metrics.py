@@ -109,6 +109,22 @@ _reg("degraded_steps_total", "counter",
      "degradation-ladder step-downs (resource-failure strikes)")
 _reg("degraded_recoveries_total", "counter",
      "degradation-ladder step-ups (recovery probes that passed)")
+_reg("journal_records_total", "counter",
+     "write-ahead journal records appended (accept/start/complete/failed)")
+_reg("journal_appended_bytes_total", "counter",
+     "bytes appended to the write-ahead journal")
+_reg("journal_fsyncs_total", "counter",
+     "group-commit fsyncs issued by the journal")
+_reg("journal_rotations_total", "counter",
+     "journal segment rotations (size-triggered)")
+_reg("journal_torn_records_total", "counter",
+     "CRC-rejected torn/corrupt records dropped at recovery")
+_reg("journal_replayed_total", "counter",
+     "journaled requests re-enqueued by startup replay")
+_reg("journal_replay_seconds_total", "counter",
+     "wall-clock seconds spent re-enqueueing journaled requests")
+_reg("journal_pending", "gauge",
+     "journaled requests not yet COMPLETE or typed FAILED (scrape-time)")
 _reg("queue_depth", "gauge", "requests currently queued")
 _reg("queued_tokens", "gauge",
      "billable (uncached) prompt-token estimate currently queued")
@@ -271,7 +287,8 @@ class ServeMetrics:
                           queued_tokens: int | None = None,
                           cache_stats: dict | None = None,
                           slot_state: tuple[int, int] | None = None,
-                          degraded_rung: int | None = None) -> str:
+                          degraded_rung: int | None = None,
+                          journal_stats: dict | None = None) -> str:
         """``cache_stats`` is the backend's prefix_cache_stats() snapshot
         (evictions / blocks_used / blocks_total), read at scrape time like
         the queue gauges — the serving layer never mirrors pool state."""
@@ -347,6 +364,22 @@ class ServeMetrics:
             # like the queue gauges — the metrics layer never mirrors it
             simple("slots_total", slot_state[0])
             simple("slots_busy", slot_state[1])
+        if journal_stats is not None:
+            # read from the live RequestJournal at scrape time, like the
+            # queue gauges — the metrics layer never mirrors ledger state
+            simple("journal_records_total", journal_stats.get("records", 0))
+            simple("journal_appended_bytes_total",
+                   journal_stats.get("appended_bytes", 0))
+            simple("journal_fsyncs_total", journal_stats.get("fsyncs", 0))
+            simple("journal_rotations_total",
+                   journal_stats.get("rotations", 0))
+            simple("journal_torn_records_total",
+                   journal_stats.get("torn_records", 0))
+            simple("journal_replayed_total",
+                   journal_stats.get("replayed", 0))
+            simple("journal_replay_seconds_total",
+                   journal_stats.get("replay_seconds", 0.0))
+            simple("journal_pending", journal_stats.get("pending", 0))
         if cache_stats is not None:
             simple("cache_evictions_total", cache_stats.get("evictions", 0))
             simple("cache_blocks_used", cache_stats.get("blocks_used", 0))

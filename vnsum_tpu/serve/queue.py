@@ -104,6 +104,12 @@ class ServeRequest:
     # engine dispatches this request has been part of; the supervisor's
     # per-request retry budget caps it
     attempts: int = 0
+    # durable-serving id (serve/journal.py): assigned by the journal's
+    # ACCEPT record at admission (trace_id, or trace_id#N for fan-out
+    # siblings); preset by startup replay so a re-enqueued request keeps
+    # its ledger identity instead of journaling a second ACCEPT. None =
+    # journaling off, or shed before admission (never accepted)
+    journal_rid: str | None = None
     enqueued_at: float = field(default_factory=time.monotonic)
     future: Future = field(default_factory=Future)
 

@@ -81,6 +81,7 @@ class MicroBatchScheduler:
         obs: ObsHub | None = None,
         trace_dir: str | None = None,
         supervisor=None,
+        journal=None,
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -88,6 +89,12 @@ class MicroBatchScheduler:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.metrics = metrics or ServeMetrics()
+        # durability (serve/journal.py): None = volatile serving (the
+        # pre-journal contract). With a RequestJournal, every admission
+        # writes an ACCEPT record before any engine work and every outcome
+        # appends COMPLETE or a typed FAILED — the at-least-once ledger a
+        # crash-restart replays
+        self.journal = journal
         # fault tolerance (serve/supervisor.py): None = pre-supervision
         # contract — an engine failure resolves every rider with the raw
         # error, no retries (what the direct-API tests pin). With a
@@ -120,7 +127,7 @@ class MicroBatchScheduler:
             max_depth=max_queue_depth, max_queued_tokens=max_queued_tokens
         )
         self.queue.on_shed = self._on_shed
-        self.queue.on_admit = lambda req: self.metrics.observe_submit()
+        self.queue.on_admit = self._on_admit
         if supervisor is not None:
             # brownout gate: at the ladder's bottom rung new EXTERNAL
             # admissions shed with a typed 503 + Retry-After; the gate call
@@ -148,6 +155,7 @@ class MicroBatchScheduler:
         trace: RequestTrace | None = None,
         trace_id: str | None = None,
         trace_owned: bool = False,
+        journal_rid: str | None = None,
     ):
         """Admit one prompt; returns a Future resolving to a _Completion.
         Raises RequestShed synchronously when admission control rejects.
@@ -172,7 +180,12 @@ class MicroBatchScheduler:
         single-prompt traces). Only a bare submit (no owner, ObsHub
         configured) samples here, so direct API users get timelines too.
         ``trace_id`` overrides the queue-derived correlation id either
-        way."""
+        way.
+
+        ``journal_rid`` presets the durable-serving ledger id
+        (serve/journal.py) — ONLY the startup replay path sets it, so a
+        re-enqueued request keeps its original ACCEPT record instead of
+        journaling a duplicate."""
         req = ServeRequest(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -182,6 +195,7 @@ class MicroBatchScheduler:
             deadline=deadline,
             est_tokens=self.backend.count_tokens(prompt),
             trace_id=trace_id or "",
+            journal_rid=journal_rid,
         )
         # admission discount: only probed when a token budget exists — the
         # probe re-tokenizes the prompt (a second pass on top of
@@ -267,8 +281,27 @@ class MicroBatchScheduler:
 
     # -- scheduler thread ------------------------------------------------
 
+    def _on_admit(self, req: ServeRequest) -> None:
+        """Queue on_admit hook (runs under the queue lock): count the
+        submit and, when durable serving is on, write the ACCEPT record —
+        BEFORE the scheduler can take the request, so no engine work ever
+        happens on an unjournaled request."""
+        self.metrics.observe_submit()
+        if self.journal is not None:
+            self.journal.accept(req)
+
+    def _journal_fail(self, req: ServeRequest, reason: str,
+                      detail: str = "") -> None:
+        """Typed-FAILED ledger append for every terminal non-success path.
+        journal_rid is None for requests shed AT admission (they were never
+        accepted, so the ledger owes them nothing) and when journaling is
+        off."""
+        if self.journal is not None and req.journal_rid is not None:
+            self.journal.fail(req.journal_rid, reason, detail)
+
     def _on_shed(self, req: ServeRequest, reason: ShedReason) -> None:
         self.metrics.observe_shed(reason)
+        self._journal_fail(req, f"shed:{reason.value}")
         # scheduler-owned traces must not leak open on the shed path; the
         # hub lock is independent of the queue lock this hook runs under
         if req.own_trace and req.trace is not None and self.obs is not None:
@@ -302,6 +335,7 @@ class MicroBatchScheduler:
                 # and /healthz would keep reporting ok
                 logger.exception("batch post-processing failed")
                 for r in batch:
+                    self._journal_fail(r, "error", str(e))
                     if not r.future.done():
                         r.future.set_exception(e)
 
@@ -328,6 +362,13 @@ class MicroBatchScheduler:
         bt) in ``_attempt_ctx`` for the resolvers, and raises."""
         head = batch[0]
         self._attempt_ctx = (time.monotonic(), 0.0, None)
+        if self.journal is not None:
+            # START marks "engine work began" — replay after a crash here
+            # recomputes from the ACCEPT payload (deterministic greedy), so
+            # START is bookkeeping for operators, not a correctness gate
+            for r in batch:
+                if r.journal_rid is not None:
+                    self.journal.start(r.journal_rid)
         # batch telemetry (vnsum_tpu.obs): the BatchTrace is installed as the
         # contextvar collector for the duration of backend.generate, so the
         # engine's prefill/decode/spec-step emits land on THIS batch's track
@@ -407,6 +448,11 @@ class MicroBatchScheduler:
             rec.cached_prompt_tokens = int(cached)
             self.metrics.observe_request(rec)
             self._trace_request(r, t0, engine_s, bt, "ok")
+            if self.journal is not None and r.journal_rid is not None:
+                # journal COMPLETE before resolving the future: a success
+                # the client saw is always in the ledger (a crash between
+                # replays the request and re-completes it identically)
+                self.journal.complete(r.journal_rid, out, n_out)
             if not r.future.done():
                 r.future.set_result(_Completion(out, rec))
 
@@ -536,6 +582,7 @@ class MicroBatchScheduler:
         expiry at retry, drain overrun): metrics + owned-trace finalization
         + the future, mirroring the queue-side shed hook."""
         self.metrics.observe_shed(reason)
+        self._journal_fail(r, f"shed:{reason.value}")
         if r.own_trace and r.trace is not None and self.obs is not None:
             self.obs.finish_request(r.trace, f"shed:{reason.value}")
             r.trace = None
@@ -582,10 +629,16 @@ class MicroBatchScheduler:
             toggle(sup.cache_inserts_enabled)
 
     def _resolve_errored(self, batch, e, t0, engine_s, bt) -> None:
+        from .supervisor import RequestFailed
+
+        reason = (
+            e.failure_class.value if isinstance(e, RequestFailed) else "error"
+        )
         for r in batch:
             rec = self._record(r, "error", t0, engine_s, len(batch), 0, bt)
             self.metrics.observe_request(rec)
             self._trace_request(r, t0, engine_s, bt, "error")
+            self._journal_fail(r, reason, str(e))
             if not r.future.done():
                 r.future.set_exception(e)
 

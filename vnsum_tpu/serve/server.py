@@ -17,6 +17,9 @@ Endpoints:
                         "reference"/"references", "cache_hint"/"cache_hints"
         Raw engine call(s) through the queue.
     GET /healthz        liveness + queue depth
+    GET /v1/requests/<id>  durable-serving poll surface (--journal-dir):
+                        status + result of a journaled request — the
+                        reconnect path after a server crash mid-request
     GET /metrics        Prometheus text (serve/metrics.py): counters plus
                         queue-wait/TTFT/e2e/occupancy/spec histograms
     GET /debug/trace    Chrome trace-event JSON of the recent-request ring
@@ -84,8 +87,21 @@ class ServeState:
         slot_prompt_tokens: int = 0,
         supervisor=None,
         supervise: bool = True,
+        journal_dir: str | None = None,
+        journal_fsync_s: float = 0.05,
     ) -> None:
         self.backend = backend
+        # durability (serve/journal.py): a --journal-dir arms the
+        # write-ahead request journal — ACCEPT/START/COMPLETE/FAILED per
+        # request, replayed by replay_journal() after a restart. None =
+        # volatile serving, the pre-journal contract
+        self.journal = None
+        if journal_dir:
+            from .journal import RequestJournal
+
+            self.journal = RequestJournal(
+                journal_dir, fsync_interval_s=journal_fsync_s
+            )
         # fault tolerance (serve/supervisor.py): ON by default for the HTTP
         # front-end — engine failures are classified, survivors retried,
         # poison requests bisected out, and repeated resource failures step
@@ -121,6 +137,7 @@ class ServeState:
             obs=self.obs,
             trace_dir=trace_dir,
             supervisor=supervisor,
+            journal=self.journal,
         )
         if inflight:
             # in-flight batching (serve/inflight.py): slot-feeding over the
@@ -167,8 +184,70 @@ class ServeState:
                 self._strategies[approach] = strat
             return strat
 
-    def close(self) -> None:
-        self.scheduler.close(drain=True)
+    def replay_journal(self) -> int:
+        """Re-enqueue every journaled ACCEPT that never reached a terminal
+        outcome, through the normal supervised path. Greedy replays are
+        byte-identical to an uninterrupted run (the ACCEPT record carries
+        the full payload incl. the sampling seed; the engine is
+        deterministic per payload). Entries whose wall-clock deadline
+        already passed fail typed (``shed:deadline``) without burning
+        engine time. Idempotent: the journal hands each unfinished entry
+        out at most once per process, so calling this twice enqueues
+        once."""
+        if self.journal is None:
+            return 0
+        t0 = time.monotonic()
+        n = 0
+        for entry in self.journal.take_unfinished():
+            p = entry.payload
+            deadline_unix = p.get("deadline_unix")
+            if deadline_unix is not None and time.time() >= deadline_unix:
+                self.journal.fail(
+                    entry.rid, "shed:deadline", "expired before replay"
+                )
+                continue
+            deadline = (
+                time.monotonic() + (deadline_unix - time.time())
+                if deadline_unix is not None else None
+            )
+            cfg = None
+            if p.get("config") is not None:
+                c = dict(p["config"])
+                c["eos_ids"] = tuple(c.get("eos_ids") or ())
+                cfg = GenerationConfig(**c)
+            try:
+                # internal=True: admission was already granted (and
+                # journaled) in the previous life of this server — replay
+                # must not shed against the depth budget of an empty queue
+                self.scheduler.submit(
+                    p.get("prompt", ""),
+                    max_new_tokens=p.get("max_new_tokens"),
+                    config=cfg,
+                    deadline=deadline,
+                    internal=True,
+                    reference=p.get("reference"),
+                    cache_hint=p.get("cache_hint"),
+                    trace_id=p.get("trace_id") or entry.rid,
+                    trace_owned=True,
+                    journal_rid=entry.rid,
+                )
+            # lint-allow[swallowed-exception]: a shutdown shed at replay is already journaled typed-FAILED by the queue's on_shed hook — the ledger entry is resolved
+            except RequestShed:
+                continue
+            n += 1
+        self.journal.note_replay(n, time.monotonic() - t0)
+        if n:
+            logger.info("journal replay: re-enqueued %d request(s)", n)
+        return n
+
+    def close(self, drain_timeout_s: float = 30.0) -> None:
+        self.scheduler.close(drain=True, timeout=drain_timeout_s)
+        if self.journal is not None:
+            # drain first so every completion is journaled, then mark the
+            # shutdown clean; drain-overrun sheds are typed FAILED records,
+            # so the seal is honest either way
+            self.journal.seal()
+            self.journal.close()
 
 
 class _BadRequest(ValueError):
@@ -310,6 +389,8 @@ def make_handler(state: ServeState):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif path.startswith("/v1/requests/"):
+                self._request_status(path[len("/v1/requests/"):])
             elif path == "/healthz":
                 sup = state.supervisor
                 payload = {
@@ -346,10 +427,60 @@ def make_handler(state: ServeState):
                             int(state.supervisor.rung)
                             if state.supervisor is not None else None
                         ),
+                        journal_stats=(
+                            state.journal.stats_dict()
+                            if state.journal is not None else None
+                        ),
                     )
                 )
             else:
                 self._json({"error": "not found"}, 404)
+
+        def _request_status(self, raw_rid: str) -> None:
+            """``GET /v1/requests/<id>`` — the reconnect-and-poll surface
+            of durable serving: a client whose connection died in a crash
+            polls the id it submitted (journaled request ids are echoed on
+            every response) and reads the replayed outcome, including the
+            COMPLETE result text."""
+            import urllib.parse
+
+            rid = urllib.parse.unquote(raw_rid)
+            if state.journal is None:
+                self._json(
+                    {"error": "journaling disabled (--journal-dir unset)"},
+                    404,
+                )
+                return
+            entries = state.journal.lookup(rid)
+            if not entries:
+                self._json(
+                    {"error": f"unknown or expired request id {rid!r}"}, 404
+                )
+                return
+            statuses = {e.status for e in entries}
+            # entries under one id are either RETRIES of one payload (same
+            # prompt — client re-submitted after a crash, at-least-once) or
+            # FAN-OUT siblings (different prompts). For retries any
+            # COMPLETE means the request succeeded, whatever a replayed
+            # duplicate did; for fan-out a failed child fails the request.
+            same_payload = len({
+                e.payload.get("prompt") for e in entries
+            }) == 1
+            if same_payload and "complete" in statuses:
+                status = "completed"
+            elif "failed" in statuses:
+                status = "failed"
+            elif statuses == {"complete"}:
+                status = "completed"
+            elif "start" in statuses or "complete" in statuses:
+                status = "started"  # partial progress across fan-out
+            else:
+                status = "accepted"
+            self._json({
+                "request_id": rid,
+                "status": status,
+                "entries": [e.to_dict() for e in entries],
+            })
 
         # request bodies beyond this are refused outright: a huge (or
         # negative, which would read to EOF and wedge the handler thread)
@@ -377,10 +508,39 @@ def make_handler(state: ServeState):
             except json.JSONDecodeError:
                 self._json({"error": "invalid JSON"}, 400)
                 return None
+            except UnicodeDecodeError:
+                # json.loads raises this (not JSONDecodeError) for bodies
+                # that aren't valid UTF-8 — without the catch it would
+                # surface as a 500 engine-error path for a client bug
+                self._json({"error": "request body is not valid UTF-8"}, 400)
+                return None
             if not isinstance(req, dict):
                 self._json({"error": "malformed request"}, 400)
                 return None
             return req
+
+        def _reject_unknown_fields(self, req: dict, allowed: frozenset) -> bool:
+            """Typed 400 for unknown top-level fields: a typo'd knob
+            (``temperatre``) silently ignored is a misconfigured request
+            served with wrong parameters — refuse loudly instead. Returns
+            True when the request was rejected."""
+            unknown = [k for k in req if k not in allowed]
+            if unknown:
+                self._json({
+                    "error": f"unknown field(s): {', '.join(sorted(unknown))}",
+                    "allowed": sorted(allowed),
+                }, 400)
+                return True
+            return False
+
+        GENERATE_FIELDS = frozenset({
+            "prompt", "prompts", "max_new_tokens", "temperature", "top_k",
+            "top_p", "seed", "spec_k", "deadline_ms", "request_id",
+            "reference", "references", "cache_hint", "cache_hints",
+        })
+        SUMMARIZE_FIELDS = frozenset({
+            "text", "approach", "max_new_tokens", "deadline_ms", "request_id",
+        })
 
         def do_POST(self) -> None:  # noqa: N802 (stdlib API)
             self._rid = None  # keep-alive: one handler serves many requests
@@ -395,6 +555,8 @@ def make_handler(state: ServeState):
         def _generate(self) -> None:
             req = self._read_json()
             if req is None:
+                return
+            if self._reject_unknown_fields(req, self.GENERATE_FIELDS):
                 return
             prompts = req.get("prompts")
             if prompts is None:
@@ -498,6 +660,8 @@ def make_handler(state: ServeState):
         def _summarize(self) -> None:
             req = self._read_json()
             if req is None:
+                return
+            if self._reject_unknown_fields(req, self.SUMMARIZE_FIELDS):
                 return
             text = req.get("text", "")
             if not isinstance(text, str) or not text.strip():
@@ -662,6 +826,27 @@ def main(argv: list[str] | None = None) -> int:
                         "shutdown dump); also arms the device_profile hook "
                         "(VNSUM_PROFILE_DIR) so the first engine batch "
                         "captures an XLA device trace alongside")
+    p.add_argument("--journal-dir", default=None,
+                   help="durable serving: write-ahead request journal "
+                        "directory (serve/journal.py). Every accepted "
+                        "request is journaled before engine work; on "
+                        "startup unfinished requests replay through the "
+                        "supervised path and finished ones answer "
+                        "GET /v1/requests/<id>")
+    p.add_argument("--journal-fsync-ms", type=float, default=50.0,
+                   help="group-commit fsync interval; every record is "
+                        "flushed to the kernel regardless (SIGKILL-safe), "
+                        "this only bounds the power-loss window")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="graceful-shutdown drain budget before queued and "
+                        "in-flight requests are shed typed")
+    # hermetic load/chaos knobs: give the fake backend the device-dispatch
+    # latency shape so kills land mid-prefill/mid-decode instead of between
+    # instantaneous calls (scripts/chaos_soak.py sets these)
+    p.add_argument("--fake-batch-overhead-ms", type=float, default=0.0,
+                   help="fake backend: fixed per-dispatch latency")
+    p.add_argument("--fake-per-prompt-ms", type=float, default=0.0,
+                   help="fake backend: marginal per-prompt latency")
     args = p.parse_args(argv)
 
     cache_blocks = 0 if args.no_prefix_cache else args.cache_blocks
@@ -683,7 +868,9 @@ def main(argv: list[str] | None = None) -> int:
         # the fake backend's synthetic cache blocks count whitespace words;
         # same budget flag, so hermetic dev servers exercise hit/evict paths
         backend = get_backend(
-            "fake", spec_k=args.spec_k, prefix_cache_blocks=cache_blocks
+            "fake", spec_k=args.spec_k, prefix_cache_blocks=cache_blocks,
+            batch_overhead_s=args.fake_batch_overhead_ms / 1000.0,
+            per_prompt_s=args.fake_per_prompt_ms / 1000.0,
         )
 
     supervisor = None
@@ -713,8 +900,39 @@ def main(argv: list[str] | None = None) -> int:
         inflight=args.inflight,
         slots=args.slots,
         slot_prompt_tokens=args.slot_prompt_tokens,
+        journal_dir=args.journal_dir,
+        journal_fsync_s=args.journal_fsync_ms / 1000.0,
     )
+    # crash recovery BEFORE accepting new traffic: unfinished journaled
+    # requests re-enqueue (the scheduler thread is already live, so replay
+    # dispatch overlaps server bring-up)
+    replayed = state.replay_journal()
+    if replayed:
+        logger.info("replaying %d journaled request(s) from %s",
+                    replayed, args.journal_dir)
     server = make_server(state, args.host, args.port)
+
+    # SIGTERM/SIGINT: drain, seal, exit 0 — an interrupted server must not
+    # die mid-batch with the journal unsealed. The handler runs ON the main
+    # thread inside serve_forever's poll loop, and shutdown() BLOCKS until
+    # that loop exits — calling it inline would deadlock, so it runs on a
+    # helper thread and the handler returns immediately.
+    import signal
+
+    def _graceful(signum, frame):
+        logger.info("signal %d: draining and sealing the journal", signum)
+        import threading
+
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    # lint-allow[swallowed-exception]: no request exists yet to resolve — logging that the embedding caller keeps signal ownership IS the handling
+    except ValueError:
+        # not the main thread (embedded/test use): the caller owns lifecycle
+        logger.debug("not installing signal handlers off the main thread")
+
     logger.info(
         "serving on http://%s:%d/ (backend=%s max_batch=%d max_wait=%.0fms)",
         args.host, args.port, backend.name, args.max_batch, args.max_wait_ms,
@@ -726,7 +944,9 @@ def main(argv: list[str] | None = None) -> int:
         pass
     finally:
         server.server_close()
-        state.close()  # drain the queue before exiting
+        # drain within the budget (overrun sheds typed), then seal+close
+        # the journal so the next start sees a clean ledger
+        state.close(drain_timeout_s=args.drain_timeout_s)
         if state.obs is not None and args.trace_dir:
             p = save_timestamped_trace(
                 state.obs.chrome_trace(), args.trace_dir, "serve"

@@ -2,10 +2,13 @@
 
 Unlike ``tests/`` (which consumes the framework), this package is part of
 the shipped tree so production code can carry permanently-wired, zero-cost
-hooks — today, the seeded fault-injection plan (:mod:`faults`) that the
-backend dispatch sites call into. Nothing here imports jax or the serving
-layer, so arming a plan can never change what gets compiled.
+hooks — the seeded fault-injection plan (:mod:`faults`) that the backend
+dispatch sites call into, and the process-kill chaos helpers
+(:mod:`chaos`) the durable-serving soak drives. Nothing here imports jax
+or the serving layer, so arming a plan can never change what gets
+compiled.
 """
+from .chaos import KillPoint, KillSchedule, ServerProcess, free_port
 from .faults import (
     FaultPlan,
     FaultSpec,
@@ -23,9 +26,13 @@ __all__ = [
     "FaultSpec",
     "InjectedFault",
     "InjectedResourceExhausted",
+    "KillPoint",
+    "KillSchedule",
+    "ServerProcess",
     "arm",
     "disarm",
     "fault",
+    "free_port",
     "injected",
     "plan_from_env",
 ]
