@@ -457,6 +457,99 @@ def inflight_phase(args) -> dict:
     }
 
 
+def sharded_phase(args) -> dict:
+    """DP-replica goodput scaling (ISSUE 11 tentpole): the r04 mixed
+    short/long workload against the in-flight server at 1 vs 2 data
+    replicas. Hermetic like every other phase — FakeBackend's DP model
+    divides per-ROW marginal costs over replicas (rows spread across the
+    data axis and run concurrently) while per-dispatch overheads and
+    per-STEP depth costs are paid in full, so the measured scaling is the
+    scheduling headroom replication actually buys, not a free-lunch cost
+    model (byte-identity of the REAL sharded engine is pinned separately
+    by tests/test_engine_sharded.py on a CPU mesh). The dp2 arm doubles
+    the slot count (each replica holds the same per-replica batch) and
+    carries mesh={data: 2} so the mesh gauges render; offered load is
+    sized to saturate BOTH arms, making goodput capacity-bound."""
+    deadline_s = args.deadline_s
+    clients = max(args.clients, 3 * args.max_batch)
+    short = "tin ngan gon sau day chi tam tu"                        # 8 words
+    long_ = "phan tich chuyen sau ve tinh hinh kinh te xa hoi " * 6  # 54
+
+    def payload(cid, i):
+        return {
+            "prompt": short if (cid + i) % 2 else long_,
+            "deadline_ms": deadline_s * 1000,
+        }
+
+    arms = {}
+    for name, rep in (("dp1", 1), ("dp2", 2)):
+        backend = FakeBackend(
+            batch_overhead_s=args.inflight_prefill_s,
+            per_step_s=args.per_step_s,
+            segment_words=args.segment_words,
+            segment_overhead_s=args.segment_overhead_s,
+            per_slot_segment_s=args.per_slot_segment_s,
+            dp_replicas=rep,
+        )
+        state = ServeState(
+            backend,
+            max_batch=args.max_batch,
+            max_wait_s=args.max_wait_ms / 1000.0,
+            max_queue_depth=128,
+            trace_sample=1.0,
+            trace_ring=64,
+            inflight=True,
+            slots=args.max_batch * rep,
+            mesh={"data": rep, "model": 1} if rep > 1 else None,
+        )
+        server = make_server(state, "127.0.0.1", 0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        loop = closed_loop(base, clients, args.per_client, deadline_s, payload)
+        mesh_gauges = []
+        if rep > 1:
+            # scrape the live server: the mesh gauges are part of what this
+            # phase certifies (device count / axis sizes / per-replica
+            # occupancy rendered from ServeState.mesh_state)
+            u = urllib.parse.urlparse(base)
+            conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+            conn.request("GET", "/metrics")
+            mesh_gauges = [
+                l for l in conn.getresponse().read().decode().splitlines()
+                if l.startswith("vnsum_serve_mesh_")
+            ]
+            conn.close()
+        server.shutdown()
+        server.server_close()
+        hists = state.scheduler.metrics.histograms_snapshot()
+        snap = state.scheduler.metrics.snapshot()
+        state.close()
+        arms[name] = {
+            **loop,
+            "slots": args.max_batch * rep,
+            "ttft_p50_s": hists["ttft_seconds"]["p50"],
+            "e2e_p50_s": hists["e2e_seconds"]["p50"],
+            "segments": snap.segments,
+            "refills": snap.refills,
+            "engine_seconds": round(snap.engine_seconds, 3),
+        }
+        if rep > 1:
+            arms[name]["mesh_gauges"] = mesh_gauges
+    dp1, dp2 = arms["dp1"], arms["dp2"]
+    return {
+        "workload": f"{clients} closed-loop clients x {args.per_client} "
+                    "requests, r04 mixed 1:1 short/long shape, identical "
+                    "load both arms; in-flight serving at 1 vs 2 DP "
+                    "replicas (2x slots, per-row costs divided, "
+                    "per-dispatch/per-step costs in full)",
+        **arms,
+        "goodput_scaling": (
+            round(dp2["goodput_rps"] / dp1["goodput_rps"], 3)
+            if dp1["goodput_rps"] else float("inf")
+        ),
+    }
+
+
 def journal_phase(args) -> dict:
     """Durable-serving overhead A/B (serve/journal.py): the offline
     closed-loop shape — identical latency model and load as the headline
@@ -572,7 +665,11 @@ def main(argv=None) -> int:
                         "falls more than this percentage below journal-off "
                         "(CI smoke passes a softer floor: shared-runner "
                         "jitter swings single-digit percentages)")
-    p.add_argument("--out", default="BENCH_serving_r05.json")
+    p.add_argument("--sharded-min-scaling", type=float, default=1.6,
+                   help="exit non-zero when 2-DP-replica goodput scales "
+                        "below this ratio on the mixed workload (CI smoke "
+                        "passes a softer floor for shared-runner jitter)")
+    p.add_argument("--out", default="BENCH_serving_r06.json")
     p.add_argument("--min-speedup", type=float, default=4.0,
                    help="exit non-zero below this goodput ratio (CI smoke "
                         "passes a softer floor: shared 2-core runners get "
@@ -694,6 +791,10 @@ def main(argv=None) -> int:
     print("journal phase ...", flush=True)
     journal = journal_phase(args)
 
+    # 8) multi-chip serving: DP-replica goodput scaling on the r04 shape
+    print("sharded phase ...", flush=True)
+    sharded = sharded_phase(args)
+
     speedup = (
         serve_closed["goodput_rps"] / serial_closed["goodput_rps"]
         if serial_closed["goodput_rps"]
@@ -731,6 +832,7 @@ def main(argv=None) -> int:
         "shared_prefix": shared_prefix,
         "inflight": inflight,
         "journal": journal,
+        "sharded": sharded,
         "serving_stats": stats.to_dict(),
         # server-side histogram snapshots (vnsum_tpu.obs): bucket counts
         # plus bucket-derived p50/p95/p99 for queue wait, TTFT, e2e latency,
@@ -774,6 +876,11 @@ def main(argv=None) -> int:
         f"{journal['journal_on']['journal_stats']['records']} records, "
         f"{journal['journal_on']['journal_stats']['fsyncs']} fsyncs)"
     )
+    print(
+        f"sharded: DP goodput x{sharded['goodput_scaling']} at 2 replicas "
+        f"({sharded['dp2']['goodput_rps']} vs "
+        f"{sharded['dp1']['goodput_rps']} rps)"
+    )
     print(f"wrote {args.out}")
     ok = (
         speedup >= args.min_speedup
@@ -784,6 +891,8 @@ def main(argv=None) -> int:
         and inflight["goodput_ratio"] >= args.inflight_min_goodput
         # durability tax stays inside the acceptance bar
         and journal["journal_overhead_pct"] <= args.journal_max_overhead_pct
+        # multi-chip serving: 2 DP replicas must actually scale goodput
+        and sharded["goodput_scaling"] >= args.sharded_min_scaling
     )
     return 0 if ok else 1
 

@@ -507,3 +507,64 @@ def test_durable_write_marker_must_be_the_word(tmp_path):
         return 1
     """
     assert lint(tmp_path, src, rules=["durable-write"]) == []
+
+
+# -- device-pinning ----------------------------------------------------------
+
+
+DEVICE_PIN_SRC = """
+    import jax
+
+    def place(x, mesh, sharding):
+        d = jax.devices()[0]                 # hard pin
+        y = jax.device_put(x)                # implicit default device
+        ok1 = jax.device_put(x, sharding)    # explicit placement: fine
+        ok2 = jax.device_put(x, device=d)    # explicit device kw: fine
+        ok3 = jax.devices()                  # enumeration alone: fine
+        return y, ok1, ok2, ok3
+"""
+
+
+def _lint_at(tmp_path, rel: str, src: str, rules=None):
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(src), encoding="utf-8")
+    return run_paths([f], root=tmp_path, rules=rules)
+
+
+def test_device_pinning_flags_pin_and_bare_device_put(tmp_path):
+    findings = _lint_at(
+        tmp_path, "backend/snippet.py", DEVICE_PIN_SRC,
+        rules=["device-pinning"],
+    )
+    assert len(findings) == 2
+    assert {"device-pinning"} == {f.rule for f in findings}
+    msgs = " ".join(f.message for f in findings)
+    assert "hard-pins" in msgs and "default device" in msgs
+
+
+def test_device_pinning_scoped_to_backend_and_cache(tmp_path):
+    # cache/ is in scope; parallel/ (mesh construction) is not
+    assert len(_lint_at(
+        tmp_path, "cache/snippet.py", DEVICE_PIN_SRC,
+        rules=["device-pinning"],
+    )) == 2
+    assert _lint_at(
+        tmp_path, "parallel/snippet.py", DEVICE_PIN_SRC,
+        rules=["device-pinning"],
+    ) == []
+
+
+def test_device_pinning_suppression_with_reason_clears(tmp_path):
+    src = DEVICE_PIN_SRC.replace(
+        "d = jax.devices()[0]",
+        "# lint-allow[device-pinning]: fixture pins deliberately\n"
+        "        d = jax.devices()[0]",
+    ).replace(
+        "y = jax.device_put(x)",
+        "# lint-allow[device-pinning]: fixture places deliberately\n"
+        "        y = jax.device_put(x)",
+    )
+    assert _lint_at(
+        tmp_path, "backend/snippet.py", src, rules=["device-pinning"]
+    ) == []

@@ -113,13 +113,16 @@ def test_mixed_lengths_group_by_suffix(cfg, params):
     assert b.generate(mixed) == want
 
 
-def test_cache_requires_single_chip(cfg, params):
-    class FakeMesh:  # minimal stand-in: engine only checks `is not None`
-        shape = {"data": 1}
+def test_cache_pool_requires_tp_divisible_kv_heads(cfg, params):
+    """The block pool shards KV heads over `model`; an indivisible config
+    must fail loudly at construction (mirroring shard_params' check), not
+    as a raw XLA error on the first gather."""
+    from vnsum_tpu.parallel import make_mesh
 
-    with pytest.raises(ValueError, match="single-chip"):
+    mesh = make_mesh({"data": 1, "model": 3, "seq": 1}, platform="cpu")
+    with pytest.raises(ValueError, match="n_kv_heads"):
         TpuBackend(
-            model_config=cfg, params=params, mesh=FakeMesh(),
+            model_config=cfg, params=params, mesh=mesh,
             max_new_tokens=16, cache_blocks=8,
         )
 

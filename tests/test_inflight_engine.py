@@ -227,8 +227,15 @@ def test_closed_loop_refuses_work():
         loop.step()
 
 
-def test_mesh_backend_refuses_slot_loop():
+def test_slot_count_must_divide_mesh_data_axis():
+    """The resident batch rows shard over `data`, so a slot count the axis
+    does not divide is a config error at loop construction, not an XLA
+    divisibility failure mid-serve."""
     b = make_backend()
-    b.mesh = object()  # simulate a sharded backend
-    with pytest.raises(ValueError, match="single-chip"):
+
+    class FakeMesh:  # engine only reads .shape before building the loop
+        shape = {"data": 3}
+
+    b.mesh = FakeMesh()
+    with pytest.raises(ValueError, match="divisible by the mesh data axis"):
         b.start_slot_loop(4)

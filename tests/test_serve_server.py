@@ -291,3 +291,37 @@ def test_all_documented_fields_still_accepted(serve_url):
         "deadline_ms": 60000, "request_id": "full-2",
     })
     assert status == 200 and d["summary"]
+
+
+def test_mesh_surface_on_healthz_and_metrics():
+    """A mesh-built server echoes its topology on /healthz and renders the
+    mesh gauges — including per-DP-replica occupancy in in-flight mode."""
+    state = ServeState(
+        FakeBackend(), max_batch=4, max_wait_s=0.005,
+        inflight=True, slots=4, mesh={"data": 2, "model": 2},
+    )
+    server = make_server(state, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        _, body = _get(base + "/healthz")
+        d = json.loads(body)
+        assert d["mesh"] == {"devices": 4, "data": 2, "model": 2}
+        _, body = _get(base + "/metrics")
+        text = body.decode()
+        assert "vnsum_serve_mesh_devices 4" in text
+        assert "vnsum_serve_mesh_data_parallel 2" in text
+        assert "vnsum_serve_mesh_model_parallel 2" in text
+        assert "vnsum_serve_mesh_replica_occupancy" in text
+    finally:
+        server.shutdown()
+        server.server_close()
+        state.close()
+
+
+def test_single_chip_server_renders_no_mesh_gauges(serve_url):
+    base, _ = serve_url
+    _, body = _get(base + "/healthz")
+    assert "mesh" not in json.loads(body)
+    _, body = _get(base + "/metrics")
+    assert "vnsum_serve_mesh_" not in body.decode()

@@ -2,6 +2,7 @@
 :mod:`vnsum_tpu.analysis.core`; add a module here and import it below to
 ship a new rule."""
 from . import (  # noqa: F401
+    device_pinning,
     donation,
     durable,
     guarded_by,

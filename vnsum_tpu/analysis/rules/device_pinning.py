@@ -1,0 +1,86 @@
+"""device-pinning: no hard-coded device-0 placement in backend/ or cache/.
+
+The bug class the multi-chip serving refactor eliminated: an engine- or
+cache-path array pinned to ``jax.devices()[0]`` (or placed by a bare
+``jax.device_put(x)`` with no sharding/device) silently anchors state on one
+chip, so the first mesh run either pays a re-layout on every dispatch or —
+worse — commits a buffer single-device and fails jit's committed-device
+consistency check in production. Device placement in those trees must be
+expressed against the mesh (``NamedSharding`` / explicit device argument) or
+left uncommitted for GSPMD to lay out.
+
+Scoped to path components named ``backend`` or ``cache``: test fixtures,
+the parallel helpers (which legitimately enumerate devices to BUILD meshes)
+and scripts are out of scope. Intended pins carry a reasoned
+``# lint-allow[device-pinning]: <why this placement is single-device>``.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..core import Finding, Rule, SourceFile, register
+
+_SCOPE_PARTS = {"backend", "cache"}
+_DEVICE_ENUMS = {"devices", "local_devices"}
+
+
+def _in_scope(path: str) -> bool:
+    return bool(_SCOPE_PARTS.intersection(Path(path).parts))
+
+
+def _is_jax_attr(node: ast.AST, names: set[str]) -> str | None:
+    """'jax.devices' / 'jax.local_devices' style attribute on the jax
+    module alias; returns the attr name or None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and node.attr in names
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    ):
+        return node.attr
+    return None
+
+
+@register
+class DevicePinningRule(Rule):
+    name = "device-pinning"
+    description = (
+        "jax.devices()[i] pins and bare jax.device_put(x) implicitly "
+        "default-device-places — banned in backend/ and cache/; mesh "
+        "placement or a reasoned lint-allow instead"
+    )
+
+    def check(self, sf: SourceFile) -> list[Finding]:
+        if not _in_scope(sf.path):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            # jax.devices(...)[i] / jax.local_devices(...)[i]
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.value, ast.Call
+            ):
+                attr = _is_jax_attr(node.value.func, _DEVICE_ENUMS)
+                if attr is not None:
+                    out.append(Finding(
+                        self.name, sf.path, node.lineno,
+                        f"jax.{attr}()[...] hard-pins a device — engine/"
+                        "cache state must be placed via the mesh "
+                        "(NamedSharding) or left for GSPMD to lay out",
+                    ))
+            # jax.device_put(x) with no device/sharding: implicit default-
+            # device placement (device_put(x, sharding) is the fix, so a
+            # second positional arg or device= keyword clears it)
+            if isinstance(node, ast.Call):
+                if (
+                    _is_jax_attr(node.func, {"device_put"})
+                    and len(node.args) < 2
+                    and not any(kw.arg == "device" for kw in node.keywords)
+                ):
+                    out.append(Finding(
+                        self.name, sf.path, node.lineno,
+                        "jax.device_put(x) without a sharding/device "
+                        "places on the implicit default device — pass a "
+                        "NamedSharding (or explicit device) instead",
+                    ))
+        return out

@@ -264,8 +264,10 @@ class TpuBackend:
         self._warned_spec_fallback = False
         # radix prefix KV cache (vnsum_tpu.cache): cache_blocks > 0 retains
         # prefix KV blocks on device after prefill and later batches resume
-        # prefill from the matched prefix, computing only the suffix.
-        # Single-chip for now, like speculative decoding's verify kernel.
+        # prefill from the matched prefix, computing only the suffix. Under
+        # a mesh the block pool shards its KV heads over `model` (mirroring
+        # cache_specs) and the gather/extract programs run as sharded
+        # dynamic_update_slice — the host-side radix index is unchanged.
         self.prefix_cache = None
         self._cache_report: list = []
         self._hint_ids_cache: dict[str, list[int]] = {}
@@ -274,11 +276,6 @@ class TpuBackend:
         # keep serving resume-prefill hits
         self.cache_inserts_enabled = True
         if cache_blocks:
-            if mesh is not None:
-                raise ValueError(
-                    "the prefix KV cache is single-chip for now; "
-                    "cache_blocks requires mesh=None"
-                )
             if not 1 <= cache_block_tokens <= 128:
                 # the resume boundary K is 128-aligned, and the padded-gather
                 # safety argument (scratch writes land inside the recomputed
@@ -291,7 +288,7 @@ class TpuBackend:
                 n_layers=self.cfg.n_layers,
                 n_kv_heads=self.cfg.n_kv_heads,
                 head_dim=self.cfg.head_dim, dtype=self.cfg.dtype,
-                quantized=self.quantize_kv,
+                quantized=self.quantize_kv, mesh=mesh,
             )
             logger.info(
                 "prefix KV cache: %d blocks x %d tokens (%.1f MB HBM)",
@@ -499,10 +496,25 @@ class TpuBackend:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            out_sh = NamedSharding(self.mesh, P("data", None))
+            if return_cache:
+                # the returned final cache keeps the (data, model) cache
+                # layout — a bare single sharding would broadcast P(data,)
+                # over every cache leaf and silently re-layout the pool copies
+                from ..parallel.sharding import cache_specs
+
+                out_sh = (
+                    out_sh,
+                    jax.tree.map(
+                        lambda s: NamedSharding(self.mesh, s),
+                        cache_specs(quantized=self.quantize_kv),
+                        is_leaf=lambda x: not isinstance(x, dict),
+                    ),
+                )
             return jax.jit(
                 generate,
                 in_shardings=self._mesh_in_shardings(),
-                out_shardings=NamedSharding(self.mesh, P("data", None)),
+                out_shardings=out_sh,
             )
         return jax.jit(generate)
 
@@ -864,8 +876,20 @@ class TpuBackend:
             return first, cache, done0
 
         if resume_from:
-            # the prefix-cache-seeded cache is consumed — donate its buffer
+            # the prefix-cache-seeded cache is consumed — donate its buffer;
+            # under a mesh its layout is committed by the sharded gather, so
+            # propagation (not in_shardings) carries the mesh layout through
             return jax.jit(slot_prefill, donate_argnums=(5,))
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # same input layouts as every other prefill builder, plus the
+            # per-request uids vector riding the batch rows on `data`
+            return jax.jit(
+                slot_prefill,
+                in_shardings=self._mesh_in_shardings()
+                + (NamedSharding(self.mesh, P("data")),),
+            )
         return jax.jit(slot_prefill)
 
     def _make_slot_segment_fn(self, B: int, S: int, max_new: int, gen):
@@ -882,7 +906,9 @@ class TpuBackend:
         C = S + max_new
         eos, vocab_limit, restrict = self._sampling_setup(gen)
         _, use_flash_decode = self._decode_settings(S, C)
-        # the per-row-fills kernel is single-chip, like the spec verify path
+        # the per-row-fills Pallas kernel is the one genuinely single-chip
+        # piece left (multi-position ragged reads, like spec verify); under
+        # a mesh the dense per-row path below serves the same math
         use_kernel = use_flash_decode and self.mesh is None
         interpret = self.interpret
         layer_window = self._layer_window_fn()
@@ -993,19 +1019,35 @@ class TpuBackend:
         segment boundary and freed slots are REFILLED from new prompts
         (chunked prefill + adopt-scatter into the resident cache) instead of
         only compacted — Orca-style iteration-level scheduling over the
-        segmented-decode machinery. Single-chip for now, like the prefix
-        cache and the spec verify kernel. ``prompt_tokens`` fixes the
-        prompt bucket S (0 = the full context minus the decode budget);
-        prompts that don't fit are rejected at admit for the caller to
-        route through the one-shot path, which remains generate()'s
-        default."""
+        segmented-decode machinery. Under a mesh the resident batch rows
+        shard over `data` and heads over `model` (the same layout every
+        other decode program uses), so the loop runs TP/DP-sharded; the
+        slot count must stay divisible by the data axis. ``prompt_tokens``
+        fixes the prompt bucket S (0 = the full context minus the decode
+        budget); prompts that don't fit are rejected at admit for the
+        caller to route through the one-shot path, which remains
+        generate()'s default."""
         from .inflight import TpuSlotLoop
 
+        n_slots = slots or self.batch_size
         if self.mesh is not None:
-            raise ValueError(
-                "the in-flight slot loop is single-chip for now; "
-                "start_slot_loop requires mesh=None"
-            )
+            data_size = self.mesh.shape.get("data", 1)
+            if n_slots % data_size:
+                raise ValueError(
+                    f"slots={n_slots} must be divisible by the mesh data "
+                    f"axis ({data_size}) — resident batch rows shard over it"
+                )
+            if data_size > 1 and n_slots < 2 * data_size:
+                # join batches need >= data_size free slots before they can
+                # form; at slots == data_size that means ONLY a fully
+                # drained loop can refill — legal, but it silently degrades
+                # iteration-level scheduling to batch dispatch
+                logger.warning(
+                    "slots=%d with mesh data axis %d: refill can only fire "
+                    "once >= %d slots are free, so in-flight joins will be "
+                    "rare — use slots >= %d to keep refill granular",
+                    n_slots, data_size, data_size, 2 * data_size,
+                )
         gen = config or self.gen_cfg
         max_new = resolve_max_new(max_new_tokens, gen, self.max_new_tokens)
         if max_new >= self.cfg.max_seq_len:
@@ -1021,8 +1063,7 @@ class TpuBackend:
                 f"{max_input} (max_seq_len - max_new_tokens)"
             )
         return TpuSlotLoop(
-            self, slots or self.batch_size, S, max_new, gen,
-            seed=self._next_seed(gen),
+            self, n_slots, S, max_new, gen, seed=self._next_seed(gen),
         )
 
     def _get_seg_fn(self, kind: str, B: int, S: int, max_new: int, gen,
@@ -1239,9 +1280,10 @@ class TpuBackend:
         eos, vocab_limit, restrict = self._sampling_setup(gen)
         pad_id = self.tok.pad_id
         _, use_flash_decode = self._decode_settings(S, C)
-        # the multi-position Pallas kernel is single-chip; under a mesh the
-        # dense per-row path still works (generate() currently prefers the
-        # plain decode program there — see the fallback in generate())
+        # the multi-position Pallas kernel is single-chip; under a data-only
+        # mesh the dense per-row verify path serves the same math (generate()
+        # degrades to plain decode only when `model` is sharded — the ragged
+        # per-row fills don't compose with head-sharded kernel dispatch yet)
         use_verify_kernel = use_flash_decode and self.mesh is None
         interpret = self.interpret
         layer_window = self._layer_window_fn()
@@ -1657,21 +1699,27 @@ class TpuBackend:
         fault("engine.dispatch", prompts=prompts)
 
         # reference-guided speculative decoding: needs spec_k > 0 AND at
-        # least one reference to draft from. The multi-position verify path
-        # is single-chip for now — under a mesh, degrade to plain decode
-        # (same outputs in greedy, just one token per step) instead of
-        # failing the request.
+        # least one reference to draft from. Data-parallel meshes run the
+        # dense verify path (rows are replica-local, same math); only
+        # `model`-sharded meshes degrade to plain decode (same outputs in
+        # greedy, just one token per step) — the multi-position verify
+        # kernel is the one genuinely single-chip piece left.
         spec_on = (
             gen.spec_k > 0
             and references is not None
             and any(references)
         )
-        if spec_on and self.mesh is not None:
+        if (
+            spec_on
+            and self.mesh is not None
+            and self.mesh.shape.get("model", 1) > 1
+        ):
             if not self._warned_spec_fallback:
                 self._warned_spec_fallback = True
                 logger.warning(
-                    "spec_k=%d requested under a mesh; speculative decoding "
-                    "is single-chip — falling back to plain decode",
+                    "spec_k=%d requested under a model-sharded mesh; the "
+                    "spec verify step is data-parallel only — falling back "
+                    "to plain decode",
                     gen.spec_k,
                 )
             spec_on = False

@@ -69,6 +69,7 @@ class FakeBackend:
         segment_overhead_s: float = 0.0,
         per_slot_segment_s: float = 0.0,
         per_step_s: float = 0.0,
+        dp_replicas: int = 1,
     ) -> None:
         self._responses = list(responses) if responses else None
         self.summary_words = summary_words
@@ -105,6 +106,13 @@ class FakeBackend:
         # slot loop pays only for the steps a segment actually runs. This
         # is the economics in-flight refill exploits, modeled symmetrically.
         self.per_step_s = per_step_s
+        # data-parallel replica model (the sharded-serving bench,
+        # scripts/bench_serving.py sharded phase): per-ROW marginal costs
+        # divide over replicas (rows spread across the data axis and run
+        # concurrently) while per-dispatch overheads and per-STEP depth
+        # costs don't — replication buys row throughput, not step latency.
+        # 1 = single-chip, every existing test unchanged.
+        self.dp_replicas = max(int(dp_replicas), 1)
         # degradation-ladder hook (serve/supervisor.py NO_CACHE_INSERT):
         # False stops prefix-index insertion while hits keep serving —
         # same contract as TpuBackend.set_prefix_cache_inserts
@@ -202,8 +210,9 @@ class FakeBackend:
             self._cache_report = []
         t0 = time.monotonic() if current_collector() is not None else 0.0
         outs_early = None
-        prefill_s = self.batch_overhead_s + self.per_token_s * uncached
-        decode_s = self.per_prompt_s * len(prompts)
+        rep = self.dp_replicas
+        prefill_s = self.batch_overhead_s + self.per_token_s * -(-uncached // rep)
+        decode_s = self.per_prompt_s * -(-len(prompts) // rep)
         if self.per_step_s:
             # the batch decodes until its LONGEST row finishes — every
             # rider pays the convoy (what in-flight refill avoids)
@@ -367,7 +376,10 @@ class FakeSlotLoop:
         else:
             uncached = sum(len(p.split()) for p in prompts)
             report = [0] * len(take)
-        prefill_s = b.batch_overhead_s + b.per_token_s * uncached
+        prefill_s = (
+            b.batch_overhead_s
+            + b.per_token_s * -(-uncached // b.dp_replicas)
+        )
         if prefill_s:
             time.sleep(prefill_s)
         prefill_end = time.monotonic()
@@ -420,7 +432,8 @@ class FakeSlotLoop:
             res.new_tokens += advance
         seg_s = (
             b.segment_overhead_s
-            + b.per_slot_segment_s * res.live
+            # live rows spread over DP replicas; segment depth doesn't
+            + b.per_slot_segment_s * -(-res.live // b.dp_replicas)
             + b.per_step_s * steps
         )
         if seg_s:

@@ -119,6 +119,21 @@ class TpuSlotLoop:
         self._t = jnp.zeros((B,), jnp.int32)
         self._out = jnp.full((B, max_new), b.tok.pad_id, jnp.int32)
         self._pads = jnp.full((B,), S, jnp.int32)
+        if b.mesh is not None:
+            # pin the per-slot vectors to the cache's batch layout (rows
+            # over `data`) instead of leaving them on the default device for
+            # GSPMD to re-layout on every segment dispatch
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            row = NamedSharding(b.mesh, P("data"))
+            self._cur = jax.device_put(self._cur, row)
+            self._done = jax.device_put(self._done, row)
+            self._t = jax.device_put(self._t, row)
+            self._out = jax.device_put(
+                self._out, NamedSharding(b.mesh, P("data", None))
+            )
+            self._pads = jax.device_put(self._pads, row)
         # host-side slot table: caller key per busy slot (None = free),
         # per-request RNG uid, last fetched per-row t; prompts are kept so
         # the fault-injection poison matcher sees residents at every
@@ -152,9 +167,12 @@ class TpuSlotLoop:
         rejected keys had prompts longer than the loop's S budget and must
         be routed through the one-shot path by the caller; items beyond the
         admitted count are simply not consumed (the caller retries at the
-        next segment boundary). Join groups are power-of-two bucketed and
-        capped at the free-slot count so every scatter target — including
-        all-pad filler rows — lands on a distinct free slot."""
+        next segment boundary). Join groups are bucketed to data_size * 2^k
+        (power of two single-chip; multiples of the mesh data axis sharded,
+        so join rows always divide over `data`) and capped at the free-slot
+        count so every scatter target — including all-pad filler rows —
+        lands on a distinct free slot; with fewer free slots than data_size
+        the admit defers to the next boundary."""
         if self._closed:
             raise RuntimeError("slot loop is closed")
         import jax
@@ -182,13 +200,22 @@ class TpuSlotLoop:
             return [], rejected
         free_slots = [s for s, k in enumerate(self._keys) if k is None]
         n = min(len(ok), len(free_slots))
-        Bj = 1
+        # the join bucket starts at the mesh data-axis size (join batches
+        # shard their rows over `data` exactly like the resident batch, so
+        # Bj must stay divisible by it; 1 single-chip) and grows by doubling
+        data_size = (
+            b.mesh.shape.get("data", 1) if b.mesh is not None else 1
+        )
+        Bj = data_size
         while Bj < n:
             Bj *= 2
         if Bj > len(free_slots):
             # the bucket's filler rows need free slots too — shrink the
-            # admit to the largest power of two that fits outright
-            n = Bj = _pow2_floor(len(free_slots))
+            # admit to the largest data_size * 2^k that fits outright; with
+            # fewer free slots than DP rows need, wait for the next boundary
+            if len(free_slots) < data_size:
+                return [], rejected
+            n = Bj = _pow2_floor(len(free_slots) // data_size) * data_size
         take = ok[:n]
 
         pc = b.prefix_cache

@@ -43,7 +43,13 @@ def _pow2_at_least(n: int) -> int:
 
 class BlockStore:
     """Device pool of ``num_blocks`` KV blocks (+1 scratch row used as the
-    padding target for ragged gathers; the radix index never hands it out)."""
+    padding target for ragged gathers; the radix index never hands it out).
+
+    Under a ``mesh`` the pool shards its KV-head dim over `model` —
+    mirroring ``parallel.sharding.cache_specs`` so gather/extract copies are
+    head-local (no resharding collective on the hot path) — and stays
+    replicated over `data`: a block is position-contiguous KV shared by ALL
+    batch rows, so every data replica must see every block."""
 
     def __init__(
         self,
@@ -55,24 +61,55 @@ class BlockStore:
         head_dim: int,
         dtype,
         quantized: bool = False,
+        mesh=None,
     ) -> None:
         import jax.numpy as jnp
 
         self.block_tokens = block_tokens
         self.scratch_id = num_blocks
+        self.mesh = mesh
         N = num_blocks + 1
         shape = (N, n_layers, n_kv_heads, block_tokens, head_dim)
+        # [N, L, KV(, BLK, hd)]: KV heads over `model`, rest replicated —
+        # allocated DIRECTLY into the sharding (a production pool is sized
+        # against the mesh's combined HBM; materializing it on one chip
+        # first would OOM at exactly the scale the mesh exists for)
+        placement = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import AXES
+
+            model_size = mesh.shape.get(AXES.model, 1)
+            if n_kv_heads % max(model_size, 1):
+                raise ValueError(
+                    f"n_kv_heads={n_kv_heads} is not divisible by mesh axis "
+                    f"'{AXES.model}' ({model_size}); shrink that axis or "
+                    "pick a TP-compatible model config"
+                )
+
+            def placement(ndim):
+                return NamedSharding(
+                    mesh,
+                    P(*((None, None, AXES.model) + (None,) * (ndim - 3))),
+                )
+
+        def zeros(shp, dt):
+            if placement is None:
+                return jnp.zeros(shp, dt)
+            return jnp.zeros(shp, dt, device=placement(len(shp)))
+
         if quantized:
             self.pool = {
-                "k": jnp.zeros(shape, jnp.int8),
-                "v": jnp.zeros(shape, jnp.int8),
-                "ks": jnp.zeros(shape[:-1], jnp.float32),
-                "vs": jnp.zeros(shape[:-1], jnp.float32),
+                "k": zeros(shape, jnp.int8),
+                "v": zeros(shape, jnp.int8),
+                "ks": zeros(shape[:-1], jnp.float32),
+                "vs": zeros(shape[:-1], jnp.float32),
             }
         else:
             self.pool = {
-                "k": jnp.zeros(shape, dtype),
-                "v": jnp.zeros(shape, dtype),
+                "k": zeros(shape, dtype),
+                "v": zeros(shape, dtype),
             }
         self._write_fns: dict = {}
         self._gather_fns: dict = {}
@@ -84,6 +121,26 @@ class BlockStore:
     @staticmethod
     def _shape_sig(cache: dict) -> tuple:
         return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in cache.items()))
+
+    def _constrain_batch_cache(self, cache: dict) -> dict:
+        """Pin a [L, B, KV, C(, hd)] batch cache to the engine's (data,
+        model) layout inside a traced gather — without this the seeded
+        cache's layout is left to GSPMD propagation and the resume prefill
+        pays a re-layout on its first touch. Identity off-mesh."""
+        if self.mesh is None:
+            return cache
+        import jax
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding import cache_specs
+
+        specs = cache_specs(quantized="ks" in cache)
+        return {
+            name: jax.lax.with_sharding_constraint(
+                buf, NamedSharding(self.mesh, specs[name])
+            )
+            for name, buf in cache.items()
+        }
 
     # -- insertion -------------------------------------------------------
 
@@ -152,9 +209,10 @@ class BlockStore:
                 return row_cache
 
             def gather_fn(pool, cache, ids, starts):
-                return jax.vmap(
+                out = jax.vmap(
                     per_row, in_axes=(None, 1, 0, 0), out_axes=1
                 )(pool, cache, ids, starts)
+                return self._constrain_batch_cache(out)
 
             fn = jax.jit(gather_fn, donate_argnums=(1,))
             self._gather_fns[key] = fn
@@ -181,13 +239,14 @@ class PrefixCache:
         head_dim: int,
         dtype,
         quantized: bool = False,
+        mesh=None,
     ) -> None:
         self.block_tokens = block_tokens
         self.index = RadixIndex(num_blocks, block_tokens)
         self.store = BlockStore(
             num_blocks, block_tokens, n_layers=n_layers,
             n_kv_heads=n_kv_heads, head_dim=head_dim, dtype=dtype,
-            quantized=quantized,
+            quantized=quantized, mesh=mesh,
         )
 
     def match(self, ids, max_tokens: int | None = None) -> Match:

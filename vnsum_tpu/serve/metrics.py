@@ -93,6 +93,14 @@ _reg("slots_total", "gauge",
      "decode slots of the in-flight loop (scrape-time; in-flight mode only)")
 _reg("slots_busy", "gauge",
      "decode slots occupied at scrape (in-flight mode only)")
+_reg("mesh_devices", "gauge",
+     "devices in the serving mesh (scrape-time; absent = single-chip)")
+_reg("mesh_data_parallel", "gauge",
+     "serving mesh data-axis size (DP replicas; batch rows shard over it)")
+_reg("mesh_model_parallel", "gauge",
+     "serving mesh model-axis size (TP degree; heads/hidden shard over it)")
+_reg("mesh_replica_occupancy", "gauge",
+     "busy in-flight slots per DP replica at scrape (in-flight mode only)")
 _reg("fault_failures_total", "counter",
      "classified engine dispatch failures, by failure class")
 _reg("fault_retries_total", "counter",
@@ -288,10 +296,13 @@ class ServeMetrics:
                           cache_stats: dict | None = None,
                           slot_state: tuple[int, int] | None = None,
                           degraded_rung: int | None = None,
-                          journal_stats: dict | None = None) -> str:
+                          journal_stats: dict | None = None,
+                          mesh_state: dict | None = None) -> str:
         """``cache_stats`` is the backend's prefix_cache_stats() snapshot
         (evictions / blocks_used / blocks_total), read at scrape time like
-        the queue gauges — the serving layer never mirrors pool state."""
+        the queue gauges — the serving layer never mirrors pool state.
+        ``mesh_state`` is ServeState.mesh_state() (devices / data / model,
+        plus replica_occupancy when the in-flight loop is live)."""
         import copy
 
         # one lock acquisition for stats AND histograms: a scrape must not
@@ -364,6 +375,15 @@ class ServeMetrics:
             # like the queue gauges — the metrics layer never mirrors it
             simple("slots_total", slot_state[0])
             simple("slots_busy", slot_state[1])
+        if mesh_state is not None:
+            # serving-mesh topology, read from the live ServeState at
+            # scrape time — absent entirely on single-chip servers
+            simple("mesh_devices", mesh_state.get("devices", 1))
+            simple("mesh_data_parallel", mesh_state.get("data", 1))
+            simple("mesh_model_parallel", mesh_state.get("model", 1))
+            if "replica_occupancy" in mesh_state:
+                simple("mesh_replica_occupancy",
+                       round(mesh_state["replica_occupancy"], 3))
         if journal_stats is not None:
             # read from the live RequestJournal at scrape time, like the
             # queue gauges — the metrics layer never mirrors ledger state

@@ -89,8 +89,14 @@ class ServeState:
         supervise: bool = True,
         journal_dir: str | None = None,
         journal_fsync_s: float = 0.05,
+        mesh=None,
     ) -> None:
         self.backend = backend
+        # multi-chip serving descriptor: a jax Mesh (or any mapping-shaped
+        # stand-in with the same {axis: size} semantics, for hermetic
+        # benches) — surfaced on /healthz and as vnsum_serve_mesh_* gauges;
+        # the backend itself was already built against it
+        self.mesh = mesh
         # durability (serve/journal.py): a --journal-dir arms the
         # write-ahead request journal — ACCEPT/START/COMPLETE/FAILED per
         # request, replayed by replay_journal() after a restart. None =
@@ -183,6 +189,22 @@ class ServeState:
                 strat = get_strategy(approach, self.backend, cfg)
                 self._strategies[approach] = strat
             return strat
+
+    def mesh_state(self) -> dict | None:
+        """{devices, data, model} for /healthz and the mesh gauges (None =
+        single-chip serving, nothing rendered). Accepts a jax Mesh or any
+        {axis: size} mapping so hermetic benches can exercise the surface."""
+        if self.mesh is None:
+            return None
+        shape = dict(getattr(self.mesh, "shape", None) or self.mesh)
+        devices = 1
+        for size in shape.values():
+            devices *= int(size)
+        return {
+            "devices": devices,
+            "data": int(shape.get("data", 1)),
+            "model": int(shape.get("model", 1)),
+        }
 
     def replay_journal(self) -> int:
         """Re-enqueue every journaled ACCEPT that never reached a terminal
@@ -400,6 +422,11 @@ def make_handler(state: ServeState):
                     "queued_tokens": state.scheduler.queue.queued_tokens,
                     "closed": state.scheduler.closed,
                 }
+                mesh_state = state.mesh_state()
+                if mesh_state is not None:
+                    # echo the serving mesh so probes/load balancers can
+                    # verify the topology a replica actually runs with
+                    payload["mesh"] = mesh_state
                 if sup is not None:
                     # the degradation ladder is health surface: "ok" only
                     # at HEALTHY, "degraded" on any lower rung so probes
@@ -417,12 +444,20 @@ def make_handler(state: ServeState):
                 slot_state = getattr(
                     state.scheduler, "slot_state", lambda: None
                 )()
+                mesh_state = state.mesh_state()
+                if mesh_state is not None and slot_state is not None:
+                    # per-DP-replica occupancy: busy slots spread over the
+                    # data axis (each replica holds slots/data rows)
+                    mesh_state["replica_occupancy"] = (
+                        slot_state[1] / mesh_state["data"]
+                    )
                 self._text(
                     state.scheduler.metrics.render_prometheus(
                         queue_depth=state.scheduler.queue.depth,
                         queued_tokens=state.scheduler.queue.queued_tokens,
                         cache_stats=cache_stats,
                         slot_state=slot_state,
+                        mesh_state=mesh_state,
                         degraded_rung=(
                             int(state.supervisor.rung)
                             if state.supervisor is not None else None
@@ -776,8 +811,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=8901)
     p.add_argument("--max-batch", type=int, default=8,
                    help="engine batch ceiling per dispatch")
+    p.add_argument("--max-new-tokens", type=int, default=1024,
+                   help="tpu backend: default decode budget (must be < the "
+                        "model's max_seq_len — small configs like --model "
+                        "tiny need this lowered)")
     p.add_argument("--max-wait-ms", type=float, default=10.0,
                    help="max time a head-of-line request waits for company")
+    p.add_argument("--mesh", default=None,
+                   help='multi-chip serving mesh spec, e.g. "data=2,model=4"'
+                        " (tpu backend only): shards the engine's decode/"
+                        "prefill/slot-loop programs over the named axes — "
+                        "batch rows over data, heads over model. Validated "
+                        "against jax.device_count(); echoed on /healthz and "
+                        "as vnsum_serve_mesh_* gauges")
     p.add_argument("--inflight", action="store_true",
                    help="in-flight batching: admit new requests into the "
                         "running decode batch at segment boundaries "
@@ -850,15 +896,34 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
 
     cache_blocks = 0 if args.no_prefix_cache else args.cache_blocks
+    mesh = None
+    if args.mesh:
+        if args.backend != "tpu":
+            p.error("--mesh requires --backend tpu")
+        import jax
+
+        from ..parallel.mesh import mesh_from_spec
+
+        try:
+            # make_mesh validates axis sizes against the device count and
+            # raises with the offending shape; surface it as a CLI error
+            # (with the live device count) instead of a traceback
+            mesh = mesh_from_spec(args.mesh)
+        # lint-allow[swallowed-exception]: p.error raises SystemExit(2) — the CLI-error path, nothing to resolve
+        except ValueError as e:
+            p.error(f"--mesh {args.mesh!r}: {e} "
+                    f"(jax.device_count()={jax.device_count()})")
     if args.backend == "tpu":
         from ..models import MODEL_REGISTRY
 
         backend = get_backend(
             "tpu", model_config=MODEL_REGISTRY[args.model](),
             batch_size=args.max_batch,
+            max_new_tokens=args.max_new_tokens,
             generation=GenerationConfig(spec_k=args.spec_k),
             cache_blocks=cache_blocks,
             cache_block_tokens=args.cache_block_tokens,
+            mesh=mesh,
         )
     elif args.backend == "ollama":
         backend = get_backend("ollama", model=args.model)
@@ -902,6 +967,7 @@ def main(argv: list[str] | None = None) -> int:
         slot_prompt_tokens=args.slot_prompt_tokens,
         journal_dir=args.journal_dir,
         journal_fsync_s=args.journal_fsync_ms / 1000.0,
+        mesh=mesh,
     )
     # crash recovery BEFORE accepting new traffic: unfinished journaled
     # requests re-enqueue (the scheduler thread is already live, so replay
