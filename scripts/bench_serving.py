@@ -103,11 +103,13 @@ class Client:
             )
             self.conn.connect()
 
-    def post(self, path: str, payload: dict) -> tuple[int, bytes]:
+    def post(self, path: str, payload: dict,
+             headers: dict | None = None) -> tuple[int, bytes]:
         """Returns (status, raw body). The body is NOT parsed here: the load
         shapes only branch on status, and json.loads on every response is
         measurable GIL work that competes with the server under test on a
-        small host."""
+        small host (the QoS phase parses selectively, off the hot loop).
+        ``headers`` adds request headers (the QoS phase's X-Tenant)."""
         body = json.dumps(payload)
         for attempt in (0, 1):
             if self.conn is None:
@@ -117,7 +119,8 @@ class Client:
             try:
                 self.conn.request(
                     "POST", path, body=body,
-                    headers={"Content-Type": "application/json"},
+                    headers={"Content-Type": "application/json",
+                             **(headers or {})},
                 )
                 resp = self.conn.getresponse()
                 data = resp.read()  # must drain for keep-alive reuse
@@ -550,6 +553,174 @@ def sharded_phase(args) -> dict:
     }
 
 
+def qos_phase(args) -> dict:
+    """Multi-tenant QoS under saturation (ISSUE 12 tentpole): the
+    interactive tenant's ANCHORED TTFT p99 with a batch tenant saturating
+    every slot vs its unloaded baseline. The lever is tier preemption +
+    WFQ: interactive arrivals evict batch-tier residents within one
+    segment and the WFQ pick admits them first, so the interactive tail
+    tracks its own prefill instead of queueing through whole batch jobs.
+
+    The batch tenant is the paper's own workload shape: a map-reduce
+    fan-out whose prompts share one long template header, sent as a
+    cache_hint — after warmup its admits prefill only the unique tail
+    from the radix cache, so its interference is slot OCCUPANCY (what
+    preemption reclaims) plus brief cached admits, not prefill monopoly.
+    Interactive prompts are unique per request (never cache-warm), so
+    the baseline TTFT is honest prefill work in both arms; preempted
+    batch jobs re-admit against their PINNED header blocks — the
+    pin-across-eviction path earning its keep. TTFT comes from the
+    per-request records the responses carry inline (anchored at each
+    joiner's own prefill end), parsed client-side off the request hot
+    loop."""
+    from vnsum_tpu.serve.qos import TenantTable, parse_tenant_specs
+
+    slots = args.qos_slots
+    # p99 over ~200 samples (2nd-worst, not the max) — the tail estimate
+    # the acceptance criterion is judged on needs more samples than the
+    # throughput phases
+    per_client = max(2 * args.per_client, 30)
+    i_words = "nguoi dung tuong tac hoi dap truc tuyen can tra loi " * 15
+    header_b = ("mau nhiem vu tom tat chuan ap dung cho moi loai tai lieu "
+                "kinh te xa hoi giao duc moi truong ") * 16
+    backend_kw = dict(
+        batch_overhead_s=0.002, per_token_s=0.0004,
+        per_step_s=0.0005, segment_words=4,
+        segment_overhead_s=0.0005, per_slot_segment_s=0.0002,
+        prefix_cache_blocks=4096, cache_block_tokens=8,
+    )
+    arms = {}
+    for name in ("unloaded", "loaded"):
+        backend = FakeBackend(**backend_kw)
+        state = ServeState(
+            backend, max_batch=slots, max_wait_s=0.005,
+            max_queue_depth=256, trace_sample=0.0,
+            inflight=True, slots=slots,
+            tenants=TenantTable(parse_tenant_specs(
+                "interactive:8:0,batch:1:0:batch"
+            )),
+        )
+        server = make_server(state, "127.0.0.1", 0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        stop = threading.Event()
+        count_lock = threading.Lock()
+        batch_done = {"n": 0}
+        batch_threads = []
+        if name == "loaded":
+            def batch_client(bid):
+                c = Client(base)
+                c.connect()
+                n = 0
+                while not stop.is_set():
+                    n += 1
+                    try:
+                        status, _ = c.post(
+                            "/v1/generate",
+                            {"prompt": header_b
+                             + f"phan cong {bid}-{n} noi dung rieng " * 3,
+                             "cache_hint": header_b},
+                            headers={"X-Tenant": "batch"},
+                        )
+                        if status == 200:
+                            with count_lock:
+                                batch_done["n"] += 1
+                    except Exception:
+                        if stop.is_set():
+                            break
+                c.close()
+            batch_threads = [
+                threading.Thread(target=batch_client, args=(bid,),
+                                 daemon=True)
+                for bid in range(args.qos_batch_clients)
+            ]
+            for t in batch_threads:
+                t.start()
+            time.sleep(0.4)  # reach steady saturation before measuring
+
+        ttfts: list[float] = []
+        lock = threading.Lock()
+        clients = args.qos_interactive_clients
+        barrier = threading.Barrier(clients + 1)
+
+        def inter_client(cid):
+            import random
+
+            rng = random.Random(1000 + cid)  # seeded: reproducible load
+            c = Client(base)
+            c.connect()
+            barrier.wait()
+            for _i in range(per_client):
+                # jittered think time breaks client lockstep (group-
+                # prefill collisions would dominate the tail in both arms)
+                # AND keeps interactive utilization well under saturation:
+                # the criterion compares the loaded tail against an
+                # unloaded baseline, which only means something when the
+                # interactive tenant is not queueing behind itself
+                time.sleep(rng.uniform(0.25, 0.45))
+                # unique per request: interactive prompts never ride the
+                # radix cache, so measured TTFT is real prefill work
+                status, raw = c.post(
+                    "/v1/generate",
+                    {"prompt": f"cau hoi {cid}-{_i} " + i_words},
+                    headers={"X-Tenant": "interactive"},
+                )
+                if status != 200:
+                    continue
+                rec = json.loads(raw)["completions"][0]["record"]
+                if rec.get("ttft_anchored"):
+                    with lock:
+                        ttfts.append(rec["ttft_s"])
+            c.close()
+
+        threads = [
+            threading.Thread(target=inter_client, args=(cid,))
+            for cid in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.monotonic()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        stop.set()
+        for t in batch_threads:
+            t.join(timeout=10)
+        server.shutdown()
+        server.server_close()
+        snap = state.scheduler.metrics.snapshot()
+        state.close()
+        arms[name] = {
+            "interactive_requests": clients * per_client,
+            "ttft_samples": len(ttfts),
+            **{f"ttft_{k}": v for k, v in _percentiles(ttfts).items()},
+            "interactive_rps": round(len(ttfts) / wall, 2) if wall else 0.0,
+            "batch_completed": batch_done["n"],
+            "preemptions": snap.preemptions,
+            "requeues": snap.requeues,
+        }
+    un, ld = arms["unloaded"], arms["loaded"]
+    degradation_pct = (
+        round((ld["ttft_p99_s"] - un["ttft_p99_s"])
+              / un["ttft_p99_s"] * 100.0, 1)
+        if un["ttft_p99_s"] else 0.0
+    )
+    return {
+        "workload": f"{args.qos_interactive_clients} interactive clients x "
+                    f"{per_client} requests (unique prompts, never "
+                    "cache-warm, jittered think time); loaded arm adds "
+                    f"{args.qos_batch_clients} closed-loop batch-tier "
+                    f"clients saturating {slots} slots with shared-header "
+                    "map-reduce jobs (radix-cached via cache_hint, so "
+                    "their interference is slot occupancy + brief cached "
+                    "admits; WFQ + preemption are the levers)",
+        "tenants": "interactive:8:0, batch:1:0:batch",
+        **arms,
+        "interactive_ttft_p99_degradation_pct": degradation_pct,
+    }
+
+
 def journal_phase(args) -> dict:
     """Durable-serving overhead A/B (serve/journal.py): the offline
     closed-loop shape — identical latency model and load as the headline
@@ -669,7 +840,16 @@ def main(argv=None) -> int:
                    help="exit non-zero when 2-DP-replica goodput scales "
                         "below this ratio on the mixed workload (CI smoke "
                         "passes a softer floor for shared-runner jitter)")
-    p.add_argument("--out", default="BENCH_serving_r06.json")
+    # QoS phase knobs (multi-tenant weighted-fair scheduling + preemption)
+    p.add_argument("--qos-slots", type=int, default=4)
+    p.add_argument("--qos-interactive-clients", type=int, default=4)
+    p.add_argument("--qos-batch-clients", type=int, default=12)
+    p.add_argument("--qos-max-ttft-pct", type=float, default=25.0,
+                   help="exit non-zero when the interactive tenant's "
+                        "anchored TTFT p99 under batch saturation degrades "
+                        "more than this percentage vs its unloaded "
+                        "baseline (CI smoke passes a softer floor)")
+    p.add_argument("--out", default="BENCH_serving_r07.json")
     p.add_argument("--min-speedup", type=float, default=4.0,
                    help="exit non-zero below this goodput ratio (CI smoke "
                         "passes a softer floor: shared 2-core runners get "
@@ -795,6 +975,10 @@ def main(argv=None) -> int:
     print("sharded phase ...", flush=True)
     sharded = sharded_phase(args)
 
+    # 9) multi-tenant QoS: interactive TTFT p99 under batch saturation
+    print("qos phase ...", flush=True)
+    qos = qos_phase(args)
+
     speedup = (
         serve_closed["goodput_rps"] / serial_closed["goodput_rps"]
         if serial_closed["goodput_rps"]
@@ -833,6 +1017,7 @@ def main(argv=None) -> int:
         "inflight": inflight,
         "journal": journal,
         "sharded": sharded,
+        "qos": qos,
         "serving_stats": stats.to_dict(),
         # server-side histogram snapshots (vnsum_tpu.obs): bucket counts
         # plus bucket-derived p50/p95/p99 for queue wait, TTFT, e2e latency,
@@ -881,6 +1066,13 @@ def main(argv=None) -> int:
         f"({sharded['dp2']['goodput_rps']} vs "
         f"{sharded['dp1']['goodput_rps']} rps)"
     )
+    print(
+        f"qos: interactive TTFT p99 {qos['unloaded']['ttft_p99_s']}s "
+        f"unloaded -> {qos['loaded']['ttft_p99_s']}s under batch "
+        f"saturation ({qos['interactive_ttft_p99_degradation_pct']}% "
+        f"degradation), {qos['loaded']['preemptions']} preemptions / "
+        f"{qos['loaded']['batch_completed']} batch jobs completed"
+    )
     print(f"wrote {args.out}")
     ok = (
         speedup >= args.min_speedup
@@ -893,6 +1085,11 @@ def main(argv=None) -> int:
         and journal["journal_overhead_pct"] <= args.journal_max_overhead_pct
         # multi-chip serving: 2 DP replicas must actually scale goodput
         and sharded["goodput_scaling"] >= args.sharded_min_scaling
+        # multi-tenant QoS: the interactive tail must hold under batch
+        # saturation, and the preemption path must actually have fired
+        # (a run that never preempted proved nothing)
+        and qos["interactive_ttft_p99_degradation_pct"] <= args.qos_max_ttft_pct
+        and qos["loaded"]["preemptions"] > 0
     )
     return 0 if ok else 1
 

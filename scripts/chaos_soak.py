@@ -103,12 +103,17 @@ def reference_output(payload: dict) -> str:
 
 class LoadDriver:
     """Closed-loop clients firing the deterministic payload stream; robust
-    to the server dying mid-request (that is the point)."""
+    to the server dying mid-request (that is the point). With ``qos=True``
+    odd clients ride the preemptible batch tenant and even ones the
+    interactive tenant (X-Tenant header) — the mix that makes the server
+    actually preempt."""
 
-    def __init__(self, port: int, clients: int, per_client: int) -> None:
+    def __init__(self, port: int, clients: int, per_client: int,
+                 qos: bool = False) -> None:
         self.port = port
         self.clients = clients
         self.per_client = per_client
+        self.qos = qos
         self.attempted: dict[str, str] = {}  # rid -> prompt
         self.completed: dict[str, str] = {}  # rid -> text (HTTP 200 seen)
         self._lock = threading.Lock()
@@ -125,10 +130,15 @@ class LoadDriver:
             rid = payload["request_id"]
             with self._lock:
                 self.attempted[rid] = payload["prompt"]
+            headers = None
+            if self.qos:
+                headers = {
+                    "X-Tenant": "batch" if cid % 2 else "interactive"
+                }
             try:
                 status, body = http_json(
                     "POST", "127.0.0.1", self.port, "/v1/generate",
-                    payload, timeout=20.0,
+                    payload, timeout=20.0, headers=headers,
                 )
                 if status == 200 and body and body.get("completions"):
                     with self._lock:
@@ -187,6 +197,18 @@ def main(argv=None) -> int:
     p.add_argument("--quiesce-timeout-s", type=float, default=60.0)
     p.add_argument("--fake-batch-overhead-ms", type=float, default=80.0)
     p.add_argument("--fake-per-prompt-ms", type=float, default=4.0)
+    p.add_argument("--qos", action="store_true",
+                   help="multi-tenant QoS soak: in-flight serving with an "
+                        "interactive + preemptible-batch tenant mix, a "
+                        "widened eviction->PREEMPTED-journal gap "
+                        "(VNSUM_CHAOS_PREEMPT_GAP_MS), and a mid_preempt "
+                        "kill point — the ledger audit then also proves "
+                        "preempted requests reach exactly one terminal "
+                        "state after restart replay")
+    p.add_argument("--preempt-gap-ms", type=float, default=120.0,
+                   help="qos mode: how long the server sleeps between slot "
+                        "eviction and the PREEMPTED journal append (the "
+                        "window kills must be able to land in)")
     p.add_argument("--out", default=None,
                    help="optional JSON artifact for the run record")
     args = p.parse_args(argv)
@@ -194,7 +216,7 @@ def main(argv=None) -> int:
     journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-chaos-")
     own_dir = args.journal_dir is None
     schedule = KillSchedule(args.seed, kills=args.kills,
-                            load_window_s=args.load_window_s)
+                            load_window_s=args.load_window_s, qos=args.qos)
     print(f"kill schedule (seed={args.seed}): "
           f"{json.dumps(schedule.describe())}", flush=True)
 
@@ -206,19 +228,46 @@ def main(argv=None) -> int:
         "--fake-batch-overhead-ms", str(args.fake_batch_overhead_ms),
         "--fake-per-prompt-ms", str(args.fake_per_prompt_ms),
     ]
+    server_env = None
+    if args.qos:
+        # in-flight + two tiers + real per-segment latency, so kills and
+        # preemptions land mid-decode rather than between instant segments
+        server_args += [
+            "--inflight", "--slots", "4",
+            "--tenants", "interactive:4:0,batch:1:0:batch",
+            "--fake-segment-overhead-ms", "30",
+        ]
+        server_env = {
+            "VNSUM_CHAOS_PREEMPT_GAP_MS": str(args.preempt_gap_ms),
+        }
     port = free_port()
-    driver = LoadDriver(port, args.clients, args.per_client)
+    driver = LoadDriver(port, args.clients, args.per_client, qos=args.qos)
     restarts = 0
+    # preemption evidence: the counter resets per process, so sample its
+    # high-water mark within each process epoch and sum across restarts
+    preempts_observed = 0
+    epoch_high = 0
+
+    def sample_preempts() -> None:
+        nonlocal epoch_high
+        n = scrape_metric(port, "vnsum_serve_qos_preemptions_total")
+        if n is not None:
+            epoch_high = max(epoch_high, n)
+
     srv = None
     try:
         srv = ServerProcess(port, journal_dir=journal_dir,
-                            extra_args=server_args)
+                            extra_args=server_args, env=server_env)
         srv.start()
         srv.wait_healthy()
         driver.start()
 
         for n, point in enumerate(schedule.points, start=1):
-            time.sleep(point.delay_s)
+            t_kill = time.monotonic() + point.delay_s
+            while time.monotonic() < t_kill:
+                time.sleep(0.05)
+                if args.qos:
+                    sample_preempts()
             if point.kind == "mid_drain":
                 print(f"[kill {n}] SIGTERM, then SIGKILL "
                       f"{point.drain_gap_s}s into the drain", flush=True)
@@ -226,12 +275,17 @@ def main(argv=None) -> int:
                 time.sleep(point.drain_gap_s)
                 srv.sigkill()
             else:
-                print(f"[kill {n}] SIGKILL after {point.delay_s}s of load",
-                      flush=True)
+                # mid_load and mid_preempt are both SIGKILL-under-load; in
+                # qos mode every preemption holds the widened gap open, so
+                # a mid_preempt draw has a real window to land in
+                print(f"[kill {n}] {point.kind}: SIGKILL after "
+                      f"{point.delay_s}s of load", flush=True)
                 srv.sigkill()
             restarts += 1
+            preempts_observed += epoch_high
+            epoch_high = 0
             srv = ServerProcess(port, journal_dir=journal_dir,
-                                extra_args=server_args)
+                                extra_args=server_args, env=server_env)
             srv.start()
             srv.wait_healthy()
 
@@ -240,10 +294,13 @@ def main(argv=None) -> int:
         t_end = time.monotonic() + args.quiesce_timeout_s
         while time.monotonic() < t_end:
             pending = scrape_metric(port, "vnsum_serve_journal_pending")
+            if args.qos:
+                sample_preempts()
             if driver.done and pending == 0:
                 break
             time.sleep(0.2)
         driver.stop()
+        preempts_observed += epoch_high
         pending = scrape_metric(port, "vnsum_serve_journal_pending")
         if pending != 0:
             print(f"FAIL: journal never quiesced (pending={pending})")
@@ -300,6 +357,8 @@ def main(argv=None) -> int:
     record = {
         "bench": "chaos_soak_process_kill",
         "seed": args.seed,
+        "qos": args.qos,
+        "preemptions_observed": preempts_observed,
         "schedule": schedule.describe(),
         "restarts": restarts,
         "last_restart_replayed": last_replayed,
@@ -328,8 +387,14 @@ def main(argv=None) -> int:
         and not client_vs_ledger
         and sealed
         and len(entries) > 0
+        # qos mode must actually exercise the preemption path: a soak
+        # that never preempted proved nothing about the mid-preempt
+        # kill window
+        and (not args.qos or preempts_observed > 0)
     )
     print("ledger invariant:", "OK" if ok else "VIOLATED")
+    if args.qos:
+        print(f"preemptions observed across processes: {preempts_observed}")
     return 0 if ok else 1
 
 

@@ -187,6 +187,82 @@ def test_refill_resumes_from_prefix_cache_under_eviction_churn():
     assert st["evictions"] > 0 or st["blocks_used"] <= 6
 
 
+# -- preemption (serve/qos.py priority tiers) --------------------------------
+
+
+def test_evict_frees_slots_and_readmit_is_byte_identical():
+    """Mid-decode eviction on the REAL loop: the victim's slot frees at the
+    next segment, survivors are unaffected, and re-admitting the evicted
+    prompt restarts it to a byte-identical greedy output — the preemption
+    round-trip losslessness claim on real engine state."""
+    b = make_backend()
+    solo = [make_backend().generate([p])[0] for p in PROMPTS[:2]]
+    loop = b.start_slot_loop(2)
+    adm, _ = loop.admit([(i, PROMPTS[i], None) for i in (0, 1)])
+    assert len(adm) == 2
+    loop.step()  # a couple of segments of real decode progress
+    loop.step()
+    victim = adm[0].key
+    evs = loop.evict([victim])
+    assert [e.key for e in evs] == [victim]
+    assert loop.free == 1 and victim not in loop.outstanding()
+    outs: dict[int, str] = {}
+    drain(loop, outs)                       # survivor finishes undisturbed
+    assert outs[1] == solo[1]
+    adm2, _ = loop.admit([(0, PROMPTS[0], None)])  # the requeue's re-admit
+    assert len(adm2) == 1
+    drain(loop, outs)
+    assert outs[0] == solo[0]
+
+
+def test_evict_pins_prefix_blocks_until_released():
+    """Eviction with the radix cache armed returns a live pin: the
+    victim's cached prefix is unevictable until the scheduler-side release
+    — and releasing restores the pre-eviction pin level."""
+    header = "tiêu đề chung: "
+    b = make_backend(cache_blocks=8, cache_block_tokens=16)
+    loop = b.start_slot_loop(2)
+    adm, rej = loop.admit([(0, header + "nội dung một hai", header)])
+    assert len(adm) == 1 and rej == []
+    loop.step()
+    assert b.prefix_cache.index.pinned_blocks == 0  # admit released its pins
+    evs = loop.evict([adm[0].key])
+    assert evs[0].pin is not None
+    assert b.prefix_cache.index.pinned_blocks > 0   # held across eviction
+    cache, match = evs[0].pin
+    cache.release(match)
+    assert b.prefix_cache.index.pinned_blocks == 0
+    loop.close()
+
+
+def test_partial_outputs_are_prefixes_of_the_final_text():
+    """The streaming harvest: per-segment partial detok of a resident row
+    extends monotonically into exactly the harvested completion text."""
+    b = make_backend()
+    loop = b.start_slot_loop(2)
+    adm, _ = loop.admit([(0, PROMPTS[2], None)])
+    assert len(adm) == 1
+    key = adm[0].key
+    snapshots = []
+    final = {}
+    for _ in range(64):
+        res = loop.step()
+        for c in res.completions:
+            final[c.key] = c.text
+        if loop.active:
+            part = loop.partial_outputs([key])
+            if part:
+                snapshots.append(part[id(key)])
+        if not loop.active:
+            break
+    assert final[0] == make_backend().generate([PROMPTS[2]])[0]
+    grown = [s for s in snapshots if s]
+    assert grown, "no partial text surfaced during decode"
+    for a, bnext in zip(grown, grown[1:]):
+        assert bnext.startswith(a)
+    assert final[0].startswith(grown[-1])
+
+
 # -- slot bookkeeping --------------------------------------------------------
 
 

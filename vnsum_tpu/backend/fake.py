@@ -324,11 +324,17 @@ class FakeSlotLoop:
 
     def __init__(self, backend: FakeBackend, slots: int, prompt_tokens: int,
                  max_new: int | None) -> None:
-        from .inflight import SegmentResult, SlotAdmission, SlotCompletion
+        from .inflight import (
+            SegmentResult,
+            SlotAdmission,
+            SlotCompletion,
+            SlotEviction,
+        )
 
         self._SegmentResult = SegmentResult
         self._SlotAdmission = SlotAdmission
         self._SlotCompletion = SlotCompletion
+        self._SlotEviction = SlotEviction
         self.backend = backend
         self.slots = int(slots)
         self.S = int(prompt_tokens)  # 0 = unlimited
@@ -454,6 +460,40 @@ class FakeSlotLoop:
         res.seconds = time.monotonic() - t0
         emit("decode_seg", t0, res.seconds, live=res.live, refill=True)
         return res
+
+    def evict(self, keys):
+        """Preemption double (mirrors TpuSlotLoop.evict): free the slots,
+        drop decode progress, and — with the synthetic radix index on —
+        return each evictee's prompt prefix PINNED so the requeue's
+        admission finds it warm and unevicted."""
+        b = self.backend
+        targets = {id(k) for k in keys}
+        out = []
+        for s, k in enumerate(self._keys):
+            if k is None or id(k) not in targets:
+                continue
+            pin = None
+            if b.prefix_index is not None:
+                words = self._prompts[s].split()
+                m = b.prefix_index.match(words, max_tokens=len(words) - 1)
+                pin = (b.prefix_index, m)
+            out.append(self._SlotEviction(key=k, slot=s, pin=pin))
+            self._keys[s] = None
+            self._words[s] = None
+            self._prompts[s] = None
+            self._emitted[s] = 0
+        return out
+
+    def partial_outputs(self, keys) -> dict:
+        """Decoded-so-far text per resident key, keyed by ``id(key)`` —
+        keys are arbitrary caller objects, not necessarily hashable
+        (mirrors TpuSlotLoop.partial_outputs)."""
+        targets = {id(k) for k in keys}
+        return {
+            id(k): " ".join(self._words[s][: self._emitted[s]])
+            for s, k in enumerate(self._keys)
+            if k is not None and id(k) in targets
+        }
 
     def outstanding(self) -> list:
         return [k for k in self._keys if k is not None]

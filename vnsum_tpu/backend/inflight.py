@@ -85,6 +85,20 @@ class SegmentResult:
     seconds: float = 0.0
 
 
+@dataclass
+class SlotEviction:
+    """One request preempted out of its decode slot (serve/qos.py priority
+    tiers). ``pin`` is a ``(cache, match)`` pair the loop took on the
+    request's prompt prefix at eviction — the blocks stay pinned against
+    LRU until the SCHEDULER releases them at the request's terminal
+    resolution, so the restarted prefill resumes warm (None when no prefix
+    cache is configured)."""
+
+    key: object
+    slot: int
+    pin: object = None
+
+
 def _pow2_floor(n: int) -> int:
     p = 1
     while p * 2 <= n:
@@ -386,6 +400,68 @@ class TpuSlotLoop:
             emit("decode_seg", t0, res.seconds, B=self.slots, S=self.S,
                  live=res.live, refill=True)
         return res
+
+    # -- preemption / streaming (serve/qos.py + serve/stream.py) ---------
+
+    def evict(self, keys) -> list[SlotEviction]:
+        """Free the slots of ``keys`` mid-decode (priority-tier
+        preemption): their done flags flip on device so the next segment
+        skips them, their host rows clear, and — when a prefix cache is
+        configured — each evictee's prompt prefix is matched and left
+        PINNED (the returned SlotEviction.pin) so its cached blocks
+        survive LRU until the scheduler releases them. The evictee's
+        decode state is dropped; a requeue restarts it from its prompt
+        (greedy restarts are byte-identical by engine determinism)."""
+        import jax.numpy as jnp
+
+        b = self.backend
+        targets = {id(k) for k in keys}
+        slots = [
+            s for s, k in enumerate(self._keys)
+            if k is not None and id(k) in targets
+        ]
+        if not slots:
+            return []
+        self._done = self._done.at[jnp.asarray(slots, jnp.int32)].set(True)
+        out: list[SlotEviction] = []
+        pc = b.prefix_cache
+        for s in slots:
+            pin = None
+            if pc is not None:
+                ids = b.tok.encode_batch([self._prompts[s]], add_bos=True)[0]
+                m = pc.match(ids, max_tokens=len(ids) - 1)
+                pin = (pc, m)
+            out.append(SlotEviction(key=self._keys[s], slot=s, pin=pin))
+            self._keys[s] = None
+            self._prompts[s] = None
+            self._admissions.pop(s, None)
+        return out
+
+    def partial_outputs(self, keys) -> dict:
+        """Decoded-so-far text per resident key, keyed by ``id(key)`` (keys
+        are arbitrary caller objects, not necessarily hashable) — the
+        streaming harvest. One explicit fetch of the output buffer per call
+        (the caller invokes it once per segment, only when streaming
+        residents exist); rows are cut at their host-tracked cursor so
+        unwritten tail slots never leak into a delta."""
+        import jax
+
+        targets = {id(k) for k in keys}
+        rows = [
+            s for s, k in enumerate(self._keys)
+            if k is not None and id(k) in targets
+        ]
+        if not rows:
+            return {}
+        # lint-allow[host-sync-in-hot-path]: the streaming harvest IS a host fetch by definition — one coalesced out-buffer read per segment, gated on streaming residents existing
+        out_h = jax.device_get(self._out)
+        eos = tuple(self.gen.eos_ids)
+        return {
+            id(self._keys[s]): self.backend._detok(
+                out_h[s][: int(self._t_host[s])], eos
+            )
+            for s in rows
+        }
 
     # -- lifecycle -------------------------------------------------------
 

@@ -170,6 +170,18 @@ class ServingStats:
     backoff_seconds: float = 0.0
     degraded_steps: int = 0
     degraded_recoveries: int = 0
+    # multi-tenant QoS (serve/qos.py): batch-tier slot evictions for
+    # interactive work, their matching requeues, per-tenant admitted
+    # requests, and per-tenant token-rate quota sheds
+    preemptions: int = 0
+    requeues: int = 0
+    tenant_requests: dict[str, int] = field(default_factory=dict)
+    quota_sheds: dict[str, int] = field(default_factory=dict)
+    # SSE streaming (serve/stream.py): streamed requests admitted, SSE
+    # events written, and streams open right now (the scrape-time gauge)
+    stream_requests: int = 0
+    stream_events: int = 0
+    streams_open: int = 0
 
     @property
     def shed_total(self) -> int:

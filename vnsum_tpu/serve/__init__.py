@@ -31,6 +31,14 @@ This package is the missing online front-end for the batched engine:
                 accepted request is journaled before engine work, outcomes
                 append COMPLETE/typed-FAILED, and a restart replays the
                 unfinished remainder byte-identically (--journal-dir)
+- qos.py        multi-tenant QoS: tenant specs (--tenants), token-bucket
+                rate quotas (typed 429 QUOTA + refill-derived Retry-After),
+                and the deficit-round-robin weighted-fair pick the queue's
+                take paths schedule with — interactive tier first, batch
+                tier preemptible in in-flight mode
+- stream.py     per-request SSE emit channel: the slot loop's harvest
+                pushes decode-progress deltas at segment boundaries;
+                concatenated deltas are byte-identical to the final text
 - metrics.py    per-request + aggregate observability: counters, rolling
                 gauges, and fixed-bucket histograms (queue wait / TTFT /
                 e2e / occupancy / accepted-per-step) in Prometheus text;
@@ -48,6 +56,8 @@ from .scheduler import MicroBatchScheduler, QueuedBackend
 from .inflight import InflightScheduler
 from .journal import JournalEntry, RequestJournal
 from .metrics import ServeMetrics
+from .qos import TenantSpec, TenantTable, TokenBucket, parse_tenant_specs
+from .stream import StreamChannel
 from .supervisor import (
     EngineSupervisor,
     FailureClass,
@@ -74,4 +84,9 @@ __all__ = [
     "ServeMetrics",
     "ServeRequest",
     "ShedReason",
+    "StreamChannel",
+    "TenantSpec",
+    "TenantTable",
+    "TokenBucket",
+    "parse_tenant_specs",
 ]

@@ -41,6 +41,7 @@ MicroBatchScheduler; only the engine-side loop differs.
 """
 from __future__ import annotations
 
+import os
 import time
 
 from ..backend.base import Backend
@@ -60,6 +61,7 @@ class InflightScheduler(MicroBatchScheduler):
         slots: int | None = None,
         slot_prompt_tokens: int = 0,
         switch_grace_s: float = 0.5,
+        preempt_budget: int = 16,
         **kw,
     ) -> None:
         if not callable(getattr(backend, "start_slot_loop", None)):
@@ -72,6 +74,18 @@ class InflightScheduler(MicroBatchScheduler):
         self.slots = slots or kw.get("max_batch", 8)
         self.slot_prompt_tokens = slot_prompt_tokens
         self.switch_grace_s = switch_grace_s
+        # preemption cap per request: a batch-tier request evicted this
+        # many times becomes non-evictable — bounded interference instead
+        # of starvation-by-interactive-pressure (it keeps its slot from
+        # then on and finishes)
+        self.preempt_budget = max(int(preempt_budget), 1)
+        # chaos-soak kill window (scripts/chaos_soak.py): sleep this long
+        # between slot eviction and the PREEMPTED journal append so an
+        # out-of-process SIGKILL can land exactly in the gap the ledger
+        # invariant must survive. 0 (the default) adds nothing
+        self._preempt_gap_s = (
+            float(os.environ.get("VNSUM_CHAOS_PREEMPT_GAP_MS", "0")) / 1000.0
+        )
         # live loop reference for scrape-time gauges (written only by the
         # scheduler thread; racy reads yield a stale gauge, never a crash)
         self._live_loop = None
@@ -107,6 +121,8 @@ class InflightScheduler(MicroBatchScheduler):
         draining = False  # queue closed: serve what remains, then exit
         while True:
             try:
+                if not draining and self.tenants is not None:
+                    self._maybe_preempt(loop, loop_key)
                 active = loop.active if loop is not None else 0
                 if not draining and not self._pending:
                     taken = self._take(loop, loop_key, active)
@@ -209,6 +225,7 @@ class InflightScheduler(MicroBatchScheduler):
             )
             self.metrics.observe_request(rec)
             self._trace_request(r, t0, max(now - t0, 0.0), None, "error")
+            self._release_preempt_pins(r)
             self._journal_fail(r, "error", str(e))
             if not r.future.done():
                 r.future.set_exception(e)
@@ -239,6 +256,88 @@ class InflightScheduler(MicroBatchScheduler):
             # so the resident batch drains and the loop is rebuilt for it
             return []
         return self.queue.take_upto(loop.free, key=loop_key)
+
+    def _maybe_preempt(self, loop, loop_key) -> None:
+        """Priority-tier preemption (serve/qos.py): when interactive work
+        waits and the loop is saturated, evict batch-tier residents —
+        release their slots, pin their prefix-cache blocks so the restart
+        prefill resumes warm, journal a typed PREEMPTED, and requeue them
+        through the journal's still-replayable ACCEPT state. The freed
+        slots refill from the queue at this very segment boundary, and the
+        WFQ pick hands them to the interactive tier first — an interactive
+        burst reclaims the engine within one segment.
+
+        Two demand signals: (a) queued interactive requests COMPATIBLE with
+        the resident key — evict exactly that many (bounded by the victims
+        available); (b) an INCOMPATIBLE interactive head older than
+        switch_grace_s — evict every batch resident so the loop drains and
+        rebuilds for the new key instead of making the head wait out a
+        long batch decode. Victims are chosen youngest-first (least decode
+        work lost), each capped at ``preempt_budget`` lifetime evictions so
+        sustained interactive pressure delays batch work but never starves
+        it."""
+        if loop is None or not loop.active or self.queue.tenants is None:
+            return
+        victims = [
+            r for r in loop.outstanding()
+            if getattr(r, "tier", "") == "batch"
+            and r.preemptions < self.preempt_budget
+            # greedy only: a restart recomputes byte-identically, which is
+            # the losslessness contract. A SAMPLED row's stream keys on its
+            # slot-admission uid — re-admission would draw a different
+            # stream, so sampled batch requests keep their slots
+            and (r.config is None
+                 or getattr(r.config, "temperature", 0.0) == 0.0)
+        ]
+        if not victims:
+            return
+        demand = 0
+        if not loop.free:
+            demand = self.queue.waiting_interactive(loop_key)
+        head = self.queue.head_info()
+        if (
+            head is not None
+            and head[0] != loop_key
+            and head[2] != "batch"
+            and time.monotonic() - head[1] > self.switch_grace_s
+        ):
+            # incompatible interactive head past grace: full drain — every
+            # batch resident goes, the loop rebuilds for the new key
+            demand = len(victims)
+        if demand <= 0:
+            return
+        # youngest-first: outstanding() is slot order; admission order is
+        # tracked per-slot, so sort by admit time (newest residents lose
+        # the least completed decode work)
+        def admitted_at(r):
+            adm = getattr(r, "inflight_admission", None)
+            return adm.admitted_at if adm is not None else 0.0
+
+        victims.sort(key=admitted_at, reverse=True)
+        evictions = loop.evict(victims[: min(demand, len(victims))])
+        if not evictions:
+            return
+        if self._preempt_gap_s:
+            # chaos kill window: eviction happened, PREEMPTED not yet
+            # journaled — the crash point the soak's ledger audit covers
+            time.sleep(self._preempt_gap_s)
+        for ev in evictions:
+            r: ServeRequest = ev.key
+            r.preemptions += 1
+            if ev.pin is not None:
+                r.preempt_pins.append(ev.pin)
+            if self.journal is not None and r.journal_rid is not None:
+                self.journal.preempt(r.journal_rid)
+            self.metrics.observe_preemption()
+            self._trace_fault(r, "preempt", None, 0.0)
+            self.queue.requeue(r)
+            if self.journal is not None and r.journal_rid is not None:
+                self.journal.requeue(r.journal_rid)
+            self.metrics.observe_requeue()
+        logger.info(
+            "preempted %d batch-tier resident(s) for interactive demand",
+            len(evictions),
+        )
 
     def _make_loop(self, head: ServeRequest):
         loop = self.backend.start_slot_loop(
@@ -321,6 +420,7 @@ class InflightScheduler(MicroBatchScheduler):
         res = loop.step()
         self.metrics.observe_segment(res.live, res.seconds, res.new_tokens)
         now = time.monotonic()
+        self._emit_stream_deltas(loop)
         for c in res.completions:
             r: ServeRequest = c.key
             adm = getattr(r, "inflight_admission", None)
@@ -350,9 +450,36 @@ class InflightScheduler(MicroBatchScheduler):
             )
             self.metrics.observe_request(rec)
             self._trace_request(r, t_admit, engine_s, None, "ok")
+            self._release_preempt_pins(r)
+            if r.stream is not None:
+                # final harvest text through the same delta path: whatever
+                # the per-segment snapshots didn't emit leaves here, so
+                # concatenated deltas == the completion text, BEFORE the
+                # future resolves (the handler drains after done)
+                r.stream.push_text(c.text)
             if self.journal is not None and r.journal_rid is not None:
                 # ledger before future, same ordering rationale as the
                 # one-shot path in scheduler._dispatch
                 self.journal.complete(r.journal_rid, c.text, c.gen_tokens)
             if not r.future.done():
                 r.future.set_result(_Completion(c.text, rec))
+
+    def _emit_stream_deltas(self, loop) -> None:
+        """Per-segment streaming harvest: fetch the decoded-so-far text of
+        every STREAMING resident (one host fetch per segment, only when
+        streaming requests are actually resident) and push the suffix
+        deltas into their channels. The first delta journals the STREAMING
+        lifecycle event."""
+        streams = [
+            r for r in loop.outstanding()
+            if getattr(r, "stream", None) is not None
+        ]
+        if not streams:
+            return
+        partials = loop.partial_outputs(streams)  # keyed by id(request)
+        for r in streams:
+            text = partials.get(id(r))
+            if text and r.stream.push_text(text) and not r.stream_journaled:
+                r.stream_journaled = True
+                if self.journal is not None and r.journal_rid is not None:
+                    self.journal.streaming(r.journal_rid)

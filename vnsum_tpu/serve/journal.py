@@ -67,6 +67,19 @@ EV_START = "start"
 EV_COMPLETE = "complete"
 EV_FAILED = "failed"
 EV_SEAL = "seal"
+# QoS lifecycle (serve/qos.py + serve/inflight.py): PREEMPTED marks a
+# batch-tier request evicted from its decode slot, REQUEUED its re-entry
+# into the queue (both non-terminal — the ACCEPT payload stays replayable,
+# so a crash anywhere in the preempt->requeue window still replays the
+# request to exactly one terminal state); STREAMING marks a request whose
+# first SSE delta left the server
+EV_PREEMPT = "preempted"
+EV_REQUEUE = "requeued"
+EV_STREAM = "streaming"
+
+# the non-terminal lifecycle states compaction must preserve (a preempted
+# entry that compacts to a bare ACCEPT would lie to GET /v1/requests/<id>)
+_NONTERMINAL_STATES = (EV_START, EV_PREEMPT, EV_REQUEUE, EV_STREAM)
 
 _SEGMENT_PREFIX = "journal."
 _SEGMENT_SUFFIX = ".jsonl"
@@ -134,7 +147,7 @@ def request_payload(req) -> dict:
     deadline_unix = None
     if req.deadline is not None:
         deadline_unix = time.time() + (req.deadline - time.monotonic())
-    return {
+    payload = {
         "prompt": req.prompt,
         "max_new_tokens": req.max_new_tokens,
         "config": cfg,
@@ -143,6 +156,14 @@ def request_payload(req) -> dict:
         "trace_id": req.trace_id,
         "deadline_unix": deadline_unix,
     }
+    # QoS class survives restart: a replayed batch-tier request must stay
+    # evictable and keep billing its tenant (omitted when default so old
+    # journals and the common single-tenant case stay byte-compatible)
+    if req.tenant:
+        payload["tenant"] = req.tenant
+    if req.tier != "interactive":
+        payload["tier"] = req.tier
+    return payload
 
 
 class RequestJournal:
@@ -235,6 +256,12 @@ class RequestJournal:
                     f.write(_encode({"e": EV_FAILED, "rid": entry.rid,
                                      "reason": entry.reason,
                                      "detail": entry.detail}))
+                elif entry.status in _NONTERMINAL_STATES:
+                    # preserve mid-lifecycle state (start / preempted /
+                    # requeued / streaming) so the poll surface stays
+                    # honest across a compacting reopen; the entry still
+                    # replays from its ACCEPT payload either way
+                    f.write(_encode({"e": entry.status, "rid": entry.rid}))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -324,6 +351,32 @@ class RequestJournal:
                 return
             entry.status = EV_START
             self._append_locked({"e": EV_START, "rid": rid}, allow_sync=True)
+
+    def _lifecycle_locked(self, rid: str, event: str) -> None:
+        """One non-terminal lifecycle transition (preempted / requeued /
+        streaming): status update + append, scheduler-thread paths only."""
+        entry = self._entries.get(rid)
+        if entry is None or entry.terminal:
+            return
+        entry.status = event
+        self._append_locked({"e": event, "rid": rid}, allow_sync=True)
+
+    def preempt(self, rid: str) -> None:
+        """The typed PREEMPTED event: the request's slot was evicted for
+        higher-priority work; its ACCEPT payload remains the replayable
+        source of truth (a crash before the matching REQUEUE still replays
+        it — the mid-preemption chaos kill point proves this)."""
+        with self._lock:
+            self._lifecycle_locked(rid, EV_PREEMPT)
+
+    def requeue(self, rid: str) -> None:
+        with self._lock:
+            self._lifecycle_locked(rid, EV_REQUEUE)
+
+    def streaming(self, rid: str) -> None:
+        """First SSE delta left the server for this request."""
+        with self._lock:
+            self._lifecycle_locked(rid, EV_STREAM)
 
     def complete(self, rid: str, text: str, gen_tokens: int = 0) -> None:
         with self._lock:
@@ -501,10 +554,10 @@ def _apply(entries: OrderedDict, rec: dict) -> bool:
         if rid not in entries:
             payload = {k: v for k, v in rec.items() if k not in ("e", "rid")}
             entries[rid] = JournalEntry(rid=rid, payload=payload)
-    elif ev == EV_START:
+    elif ev in _NONTERMINAL_STATES:
         entry = entries.get(rid)
         if entry is not None and not entry.terminal:
-            entry.status = EV_START
+            entry.status = ev
     elif ev == EV_COMPLETE:
         entry = entries.get(rid)
         if entry is not None and not entry.terminal:

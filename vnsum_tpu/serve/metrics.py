@@ -117,6 +117,24 @@ _reg("degraded_steps_total", "counter",
      "degradation-ladder step-downs (resource-failure strikes)")
 _reg("degraded_recoveries_total", "counter",
      "degradation-ladder step-ups (recovery probes that passed)")
+_reg("qos_tenants", "gauge",
+     "tenants declared in the QoS table (scrape-time; absent = no table)")
+_reg("qos_requests_total", "counter",
+     "requests admitted, by tenant (QoS table mode only)")
+_reg("qos_quota_sheds_total", "counter",
+     "typed QUOTA sheds (token-rate bucket dry), by tenant")
+_reg("qos_bucket_tokens", "gauge",
+     "token-rate bucket level at scrape, by tenant (rate-limited tenants)")
+_reg("qos_preemptions_total", "counter",
+     "batch-tier slot evictions performed for interactive work")
+_reg("qos_requeues_total", "counter",
+     "preempted requests re-admitted through the queue")
+_reg("stream_requests_total", "counter",
+     "requests served with SSE streaming (stream=true)")
+_reg("stream_events_total", "counter",
+     "SSE events written to streaming responses (deltas + progress + done)")
+_reg("stream_active", "gauge",
+     "streaming responses open right now")
 _reg("journal_records_total", "counter",
      "write-ahead journal records appended (accept/start/complete/failed)")
 _reg("journal_appended_bytes_total", "counter",
@@ -242,6 +260,42 @@ class ServeMetrics:
         with self._lock:
             self._stats.backoff_seconds += seconds
 
+    # -- QoS / streaming hooks (serve/qos.py + serve/stream.py) -----------
+
+    def observe_tenant_request(self, tenant: str, n: int = 1) -> None:
+        with self._lock:
+            t = self._stats.tenant_requests
+            t[tenant] = t.get(tenant, 0) + n
+
+    def observe_quota_shed(self, tenant: str, n: int = 1) -> None:
+        with self._lock:
+            q = self._stats.quota_sheds
+            q[tenant] = q.get(tenant, 0) + n
+
+    def observe_preemption(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.preemptions += n
+
+    def observe_requeue(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.requeues += n
+
+    def observe_stream_request(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.stream_requests += n
+
+    def observe_stream_events(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.stream_events += n
+
+    def observe_stream_open(self, delta: int) -> None:
+        """+1 when an SSE response opens, -1 when it closes — the
+        streams_open gauge."""
+        with self._lock:
+            self._stats.streams_open = max(
+                self._stats.streams_open + delta, 0
+            )
+
     def observe_degraded(self, down: bool) -> None:
         """One ladder transition: down=True is a step-down (strike
         threshold), False a recovery step-up."""
@@ -297,12 +351,16 @@ class ServeMetrics:
                           slot_state: tuple[int, int] | None = None,
                           degraded_rung: int | None = None,
                           journal_stats: dict | None = None,
-                          mesh_state: dict | None = None) -> str:
+                          mesh_state: dict | None = None,
+                          qos_state: dict | None = None) -> str:
         """``cache_stats`` is the backend's prefix_cache_stats() snapshot
         (evictions / blocks_used / blocks_total), read at scrape time like
         the queue gauges — the serving layer never mirrors pool state.
         ``mesh_state`` is ServeState.mesh_state() (devices / data / model,
-        plus replica_occupancy when the in-flight loop is live)."""
+        plus replica_occupancy when the in-flight loop is live).
+        ``qos_state`` is TenantTable.stats() (per-tenant config + bucket
+        levels), read from the live table at scrape time — absent entirely
+        on servers without a tenant table."""
         import copy
 
         # one lock acquisition for stats AND histograms: a scrape must not
@@ -366,6 +424,36 @@ class ServeMetrics:
         simple("fault_backoff_seconds_total", round(s.backoff_seconds, 6))
         simple("degraded_steps_total", s.degraded_steps)
         simple("degraded_recoveries_total", s.degraded_recoveries)
+        simple("qos_preemptions_total", s.preemptions)
+        simple("qos_requeues_total", s.requeues)
+        simple("stream_requests_total", s.stream_requests)
+        simple("stream_events_total", s.stream_events)
+        simple("stream_active", s.streams_open)
+        if qos_state is not None:
+            # per-tenant series, read from the live TenantTable at scrape
+            # time like the queue gauges — the metrics layer never mirrors
+            # bucket state. Label sets are the DECLARED tenants, so
+            # dashboards see every series from the first scrape
+            simple("qos_tenants", len(qos_state))
+
+            def labeled(name, label_val, value):
+                typ, help_ = _METRICS[name]
+                header = f"# HELP {_PREFIX}{name} {help_}"
+                if header not in lines:
+                    lines.append(header)
+                    lines.append(f"# TYPE {_PREFIX}{name} {typ}")
+                lines.append(
+                    f'{_PREFIX}{name}{{tenant="{label_val}"}} {value}'
+                )
+
+            for tenant in sorted(qos_state):
+                t = qos_state[tenant]
+                labeled("qos_requests_total", tenant,
+                        s.tenant_requests.get(tenant, 0))
+                labeled("qos_quota_sheds_total", tenant,
+                        s.quota_sheds.get(tenant, 0))
+                if t.get("bucket_tokens") is not None:
+                    labeled("qos_bucket_tokens", tenant, t["bucket_tokens"])
         if degraded_rung is not None:
             # read from the live supervisor at scrape time, like the queue
             # gauges — the metrics layer never mirrors ladder state

@@ -82,6 +82,7 @@ class MicroBatchScheduler:
         trace_dir: str | None = None,
         supervisor=None,
         journal=None,
+        tenants=None,
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -123,8 +124,13 @@ class MicroBatchScheduler:
         # server — so the capture is one-shot, never per batch
         self._trace_dir = trace_dir
         self._profile_pending = trace_dir is not None
+        # multi-tenant QoS (serve/qos.py): the TenantTable arms per-tenant
+        # quotas + the weighted-fair pick inside the queue; None = the
+        # pre-QoS single-class contract
+        self.tenants = tenants
         self.queue = RequestQueue(
-            max_depth=max_queue_depth, max_queued_tokens=max_queued_tokens
+            max_depth=max_queue_depth, max_queued_tokens=max_queued_tokens,
+            tenants=tenants,
         )
         self.queue.on_shed = self._on_shed
         self.queue.on_admit = self._on_admit
@@ -156,6 +162,9 @@ class MicroBatchScheduler:
         trace_id: str | None = None,
         trace_owned: bool = False,
         journal_rid: str | None = None,
+        tenant: str = "",
+        tier: str = "interactive",
+        stream=None,
     ):
         """Admit one prompt; returns a Future resolving to a _Completion.
         Raises RequestShed synchronously when admission control rejects.
@@ -185,7 +194,13 @@ class MicroBatchScheduler:
         ``journal_rid`` presets the durable-serving ledger id
         (serve/journal.py) — ONLY the startup replay path sets it, so a
         re-enqueued request keeps its original ACCEPT record instead of
-        journaling a duplicate."""
+        journaling a duplicate.
+
+        ``tenant``/``tier`` are the QoS class (serve/qos.py): the tenant
+        bills the token-rate quota and shares via the weighted-fair pick;
+        tier "batch" marks the request preemptible in in-flight mode.
+        ``stream`` is a serve/stream.StreamChannel the scheduler pushes
+        decode-progress text into (the HTTP layer's SSE source)."""
         req = ServeRequest(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -196,6 +211,9 @@ class MicroBatchScheduler:
             est_tokens=self.backend.count_tokens(prompt),
             trace_id=trace_id or "",
             journal_rid=journal_rid,
+            tenant=tenant,
+            tier=tier,
+            stream=stream,
         )
         # admission discount: only probed when a token budget exists — the
         # probe re-tokenizes the prompt (a second pass on top of
@@ -219,13 +237,17 @@ class MicroBatchScheduler:
         # lock, so metrics can never show a completion before its submit
         return self.queue.submit(req, force=internal)  # raises RequestShed
 
-    def check_admission(self, est_tokens: int = 0) -> None:
+    def check_admission(self, est_tokens: int = 0, tenant: str = "") -> None:
         """Request-level admission gate for entry points that fan out via
-        internal submits; sheds are counted in metrics like any other."""
+        internal submits; sheds are counted in metrics like any other.
+        ``tenant`` bills the whole request's tokens against its quota
+        bucket here, once — the fan-out's internal submits bill nothing."""
         try:
-            self.queue.check_admission(est_tokens)
+            self.queue.check_admission(est_tokens, tenant)
         except RequestShed as e:
             self.metrics.observe_shed(e.reason)
+            if e.reason is ShedReason.QUOTA:
+                self.metrics.observe_quota_shed(tenant or "default")
             raise
 
     def submit_many(self, prompts, references=None, cache_hints=None, **kw):
@@ -257,12 +279,15 @@ class MicroBatchScheduler:
         trace: RequestTrace | None = None,
         trace_id: str | None = None,
         trace_owned: bool = False,
+        tenant: str = "",
+        tier: str = "interactive",
     ) -> list[_Completion]:
         futs = self.submit_many(
             prompts, references=references, cache_hints=cache_hints,
             max_new_tokens=max_new_tokens,
             config=config, deadline=deadline, internal=internal,
             trace=trace, trace_id=trace_id, trace_owned=trace_owned,
+            tenant=tenant, tier=tier,
         )
         return [f.result() for f in futs]
 
@@ -271,13 +296,18 @@ class MicroBatchScheduler:
         deadline: float | None = None,
         trace: RequestTrace | None = None,
         trace_id: str | None = None,
+        tenant: str = "",
+        tier: str = "interactive",
     ) -> "QueuedBackend":
         """A Backend-protocol view whose generate() routes through this
         scheduler — hand it to a strategy to make its rounds coalesce with
         everyone else's. A ``trace`` makes every round's prompt record its
-        spans on that ONE request timeline (per-prompt sub-tracks)."""
+        spans on that ONE request timeline (per-prompt sub-tracks).
+        ``tenant``/``tier`` stamp every fanned-out prompt with the
+        request's QoS class, so a batch-tier summarize's map round stays
+        preemptible and WFQ-scheduled."""
         return QueuedBackend(self, deadline=deadline, trace=trace,
-                             trace_id=trace_id)
+                             trace_id=trace_id, tenant=tenant, tier=tier)
 
     # -- scheduler thread ------------------------------------------------
 
@@ -287,6 +317,10 @@ class MicroBatchScheduler:
         BEFORE the scheduler can take the request, so no engine work ever
         happens on an unjournaled request."""
         self.metrics.observe_submit()
+        if self.tenants is not None:
+            self.metrics.observe_tenant_request(req.tenant or "default")
+        if req.stream is not None:
+            self.metrics.observe_stream_request()
         if self.journal is not None:
             self.journal.accept(req)
 
@@ -301,6 +335,9 @@ class MicroBatchScheduler:
 
     def _on_shed(self, req: ServeRequest, reason: ShedReason) -> None:
         self.metrics.observe_shed(reason)
+        if reason is ShedReason.QUOTA:
+            self.metrics.observe_quota_shed(req.tenant or "default")
+        self._release_preempt_pins(req)
         self._journal_fail(req, f"shed:{reason.value}")
         # scheduler-owned traces must not leak open on the shed path; the
         # hub lock is independent of the queue lock this hook runs under
@@ -448,6 +485,12 @@ class MicroBatchScheduler:
             rec.cached_prompt_tokens = int(cached)
             self.metrics.observe_request(rec)
             self._trace_request(r, t0, engine_s, bt, "ok")
+            self._release_preempt_pins(r)
+            if r.stream is not None:
+                # the one-shot program has no observable mid-decode
+                # boundary: the whole text leaves as one delta, BEFORE the
+                # future resolves so the handler's drain-after-done sees it
+                r.stream.push_text(out)
             if self.journal is not None and r.journal_rid is not None:
                 # journal COMPLETE before resolving the future: a success
                 # the client saw is always in the ledger (a crash between
@@ -577,11 +620,21 @@ class MicroBatchScheduler:
         exc = RequestFailed(failure_class, detail=str(e), cause=e)
         self._resolve_errored(group, exc, t0, engine_s, bt)
 
+    def _release_preempt_pins(self, r: ServeRequest) -> None:
+        """Drop the prefix-cache pins a preemption took (serve/inflight.py):
+        the blocks were held so a preempted request's cached prefix
+        survives LRU until it terminally resolves — every resolution path
+        (complete, errored, shed) funnels through here. Idempotent."""
+        pins, r.preempt_pins = r.preempt_pins, []
+        for cache, match in pins:
+            cache.release(match)
+
     def _shed_taken(self, r: ServeRequest, reason: ShedReason) -> None:
         """Typed shed for a request already taken off the queue (deadline
         expiry at retry, drain overrun): metrics + owned-trace finalization
         + the future, mirroring the queue-side shed hook."""
         self.metrics.observe_shed(reason)
+        self._release_preempt_pins(r)
         self._journal_fail(r, f"shed:{reason.value}")
         if r.own_trace and r.trace is not None and self.obs is not None:
             self.obs.finish_request(r.trace, f"shed:{reason.value}")
@@ -638,6 +691,7 @@ class MicroBatchScheduler:
             rec = self._record(r, "error", t0, engine_s, len(batch), 0, bt)
             self.metrics.observe_request(rec)
             self._trace_request(r, t0, engine_s, bt, "error")
+            self._release_preempt_pins(r)
             self._journal_fail(r, reason, str(e))
             if not r.future.done():
                 r.future.set_exception(e)
@@ -748,7 +802,8 @@ class QueuedBackend:
     def __init__(self, scheduler: MicroBatchScheduler,
                  deadline: float | None = None,
                  trace: RequestTrace | None = None,
-                 trace_id: str | None = None) -> None:
+                 trace_id: str | None = None,
+                 tenant: str = "", tier: str = "interactive") -> None:
         self.scheduler = scheduler
         self.deadline = deadline
         # ONE RequestTrace for the whole strategy run: every round's prompts
@@ -756,6 +811,13 @@ class QueuedBackend:
         # as one process with its map/collapse fan-out side by side
         self.trace = trace
         self.trace_id = trace_id
+        # QoS class every fanned-out prompt inherits (serve/qos.py)
+        self.tenant = tenant
+        self.tier = tier
+        # streaming-summarize progress hook (serve/server.py): called with
+        # the completed-prompt count after each round's completions land —
+        # the SSE "progress" event source. None = no streaming
+        self.progress = None
         self.records: list[ServeRequestRecord] = []  # guarded by: _lock
         # lock-order-sanitizer hook: plain threading.Lock in production
         self._lock = make_lock("serve.queued_backend")
@@ -781,9 +843,13 @@ class QueuedBackend:
             deadline=self.deadline, internal=True, references=references,
             cache_hints=cache_hints,
             trace=self.trace, trace_id=self.trace_id, trace_owned=True,
+            tenant=self.tenant, tier=self.tier,
         )
         with self._lock:
             self.records.extend(c.record for c in completions)
+            done = len(self.records)
+        if self.progress is not None:
+            self.progress(done)
         return [c.text for c in completions]
 
     def count_tokens(self, text: str) -> int:

@@ -14,8 +14,21 @@ Endpoints:
     POST /v1/generate   {"prompt": str} | {"prompts": [str, ...]},
                         optional "max_new_tokens", "temperature", "top_k",
                         "top_p", "seed", "deadline_ms", "request_id",
-                        "reference"/"references", "cache_hint"/"cache_hints"
-        Raw engine call(s) through the queue.
+                        "reference"/"references", "cache_hint"/"cache_hints",
+                        "stream"
+        Raw engine call(s) through the queue. ``"stream": true`` (single
+        prompt) answers as Server-Sent Events: ``delta`` events carry text
+        as decode segments retire it (concatenated deltas are byte-
+        identical to the final text) and the terminal ``done`` event
+        carries the exact non-streaming payload. /v1/summarize accepts
+        ``stream`` too (``progress`` events per strategy round + the same
+        ``done`` payload).
+
+    Multi-tenant QoS (--tenants, serve/qos.py): requests carry an X-Tenant
+    header; tenants share the engine by weighted-fair (deficit-round-robin)
+    scheduling, token-rate quotas shed typed 429 QUOTA with a refill-derived
+    Retry-After, and batch-tier requests are preemptible in --inflight mode
+    (typed PREEMPTED/REQUEUED journal lifecycle, byte-identical completion).
     GET /healthz        liveness + queue depth
     GET /v1/requests/<id>  durable-serving poll surface (--journal-dir):
                         status + result of a journaled request — the
@@ -90,8 +103,15 @@ class ServeState:
         journal_dir: str | None = None,
         journal_fsync_s: float = 0.05,
         mesh=None,
+        tenants=None,
     ) -> None:
         self.backend = backend
+        # multi-tenant QoS (serve/qos.py): a TenantTable arms per-tenant
+        # weighted-fair scheduling + token-rate quotas in the queue and
+        # the X-Tenant header on the HTTP surface; batch-tier tenants'
+        # requests become preemptible in in-flight mode. None = every
+        # caller is one class, the pre-QoS contract
+        self.tenants = tenants
         # multi-chip serving descriptor: a jax Mesh (or any mapping-shaped
         # stand-in with the same {axis: size} semantics, for hermetic
         # benches) — surfaced on /healthz and as vnsum_serve_mesh_* gauges;
@@ -144,6 +164,7 @@ class ServeState:
             trace_dir=trace_dir,
             supervisor=supervisor,
             journal=self.journal,
+            tenants=tenants,
         )
         if inflight:
             # in-flight batching (serve/inflight.py): slot-feeding over the
@@ -252,6 +273,11 @@ class ServeState:
                     trace_id=p.get("trace_id") or entry.rid,
                     trace_owned=True,
                     journal_rid=entry.rid,
+                    # the QoS class rides the ACCEPT payload: a replayed
+                    # batch-tier request stays preemptible and keeps
+                    # billing its tenant
+                    tenant=p.get("tenant", ""),
+                    tier=p.get("tier", "interactive"),
                 )
             # lint-allow[swallowed-exception]: a shutdown shed at replay is already journaled typed-FAILED by the queue's on_shed hook — the ledger entry is resolved
             except RequestShed:
@@ -367,18 +393,18 @@ def make_handler(state: ServeState):
             self.wfile.write(body)
 
         def _shed_response(self, e: RequestShed) -> None:
-            """The typed shed contract: admission/deadline sheds are 429;
-            a supervisor BROWNOUT is 503 with a Retry-After header — the
-            machine-readable 'back off, the server is degraded' signal."""
+            """The typed shed contract: admission/deadline/quota sheds are
+            429, a supervisor BROWNOUT is 503 — and EVERY shed carries a
+            Retry-After header, derived where the shed was decided (queue
+            depth for queue_full/token_budget, the tenant bucket's exact
+            refill for quota, 1s for an expired client deadline) — the
+            machine-readable back-off signal."""
             payload: dict = {"error": "shed", "reason": e.reason.value}
-            headers = None
-            status = 429
-            if e.reason is ShedReason.BROWNOUT:
-                status = 503
-                retry_after = e.retry_after_s or 1.0
-                payload["retry_after_s"] = retry_after
-                # Retry-After is delta-seconds, integral, at least 1
-                headers = {"Retry-After": str(max(1, int(round(retry_after))))}
+            status = 503 if e.reason is ShedReason.BROWNOUT else 429
+            retry_after = e.retry_after_s or 1.0
+            payload["retry_after_s"] = retry_after
+            # Retry-After is delta-seconds, integral, at least 1
+            headers = {"Retry-After": str(max(1, int(round(retry_after))))}
             self._json(payload, status, headers)
 
         def _text(self, body: str, status: int = 200) -> None:
@@ -427,6 +453,14 @@ def make_handler(state: ServeState):
                     # echo the serving mesh so probes/load balancers can
                     # verify the topology a replica actually runs with
                     payload["mesh"] = mesh_state
+                if state.tenants is not None:
+                    # echo the QoS table (name -> weight/rate/tier) so
+                    # operators can verify what a replica actually enforces
+                    payload["tenants"] = {
+                        name: {k: t[k]
+                               for k in ("weight", "token_rate", "tier")}
+                        for name, t in state.tenants.stats().items()
+                    }
                 if sup is not None:
                     # the degradation ladder is health surface: "ok" only
                     # at HEALTHY, "degraded" on any lower rung so probes
@@ -466,6 +500,10 @@ def make_handler(state: ServeState):
                             state.journal.stats_dict()
                             if state.journal is not None else None
                         ),
+                        qos_state=(
+                            state.tenants.stats()
+                            if state.tenants is not None else None
+                        ),
                     )
                 )
             else:
@@ -498,6 +536,10 @@ def make_handler(state: ServeState):
             # FAN-OUT siblings (different prompts). For retries any
             # COMPLETE means the request succeeded, whatever a replayed
             # duplicate did; for fan-out a failed child fails the request.
+            # Mid-lifecycle precedence (QoS + streaming states): any child
+            # actively on the engine (streaming > started) outranks one
+            # parked by preemption (requeued > preempted) — the aggregate
+            # answers "is anything moving", not "is everything moving".
             same_payload = len({
                 e.payload.get("prompt") for e in entries
             }) == 1
@@ -507,8 +549,14 @@ def make_handler(state: ServeState):
                 status = "failed"
             elif statuses == {"complete"}:
                 status = "completed"
+            elif "streaming" in statuses:
+                status = "streaming"
             elif "start" in statuses or "complete" in statuses:
                 status = "started"  # partial progress across fan-out
+            elif "requeued" in statuses:
+                status = "requeued"  # preempted, back in the queue
+            elif "preempted" in statuses:
+                status = "preempted"  # evicted, requeue not yet journaled
             else:
                 status = "accepted"
             self._json({
@@ -572,10 +620,111 @@ def make_handler(state: ServeState):
             "prompt", "prompts", "max_new_tokens", "temperature", "top_k",
             "top_p", "seed", "spec_k", "deadline_ms", "request_id",
             "reference", "references", "cache_hint", "cache_hints",
+            "stream",
         })
         SUMMARIZE_FIELDS = frozenset({
             "text", "approach", "max_new_tokens", "deadline_ms", "request_id",
+            "stream",
         })
+
+        def _qos_class(self) -> tuple[str, str] | None:
+            """(tenant, tier) from the X-Tenant header against the QoS
+            table; no table -> the single-class default. An unknown tenant
+            is a typed 400 (never a silent default bucket) — returns None
+            after responding."""
+            if state.tenants is None:
+                return "", "interactive"
+            from .qos import UnknownTenant
+
+            try:
+                spec = state.tenants.resolve(self.headers.get("X-Tenant"))
+            except UnknownTenant as e:
+                self._json({"error": str(e)}, 400)
+                return None
+            return spec.name, spec.tier
+
+        def _stream_requested(self, req: dict) -> bool:
+            return bool(req.get("stream"))
+
+        # -- SSE plumbing (serve/stream.py) ---------------------------------
+
+        def _sse_begin(self) -> None:
+            """Open the event stream: no Content-Length (the response ends
+            when the request does), so the connection closes after — the
+            one response shape keep-alive can't carry."""
+            self.close_connection = True
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream; charset=utf-8")
+            self.send_header("Cache-Control", "no-store")
+            if self._rid is not None:
+                self.send_header("X-Request-Id", self._rid)
+            self.send_header("Connection", "close")
+            self.end_headers()
+
+        def _sse_event(self, name: str, payload: dict) -> None:
+            data = json.dumps(payload, ensure_ascii=False)
+            self.wfile.write(f"event: {name}\ndata: {data}\n\n".encode())
+            self.wfile.flush()
+            state.scheduler.metrics.observe_stream_events()
+
+        def _stream_response(self, channel, done, finish) -> None:
+            """Drain ``channel`` into SSE frames until ``done()`` turns
+            true and the channel is empty, then write the terminal event
+            from ``finish()`` -> (event_name, payload). The terminal
+            payload of a successful request is THE SAME payload the
+            non-streaming path returns. A disconnecting client stops the
+            writes but never the request — the engine side owns its own
+            lifecycle."""
+            metrics = state.scheduler.metrics
+            metrics.observe_stream_open(+1)
+            try:
+                self._sse_begin()
+                while True:
+                    ev = channel.pop(0.05)
+                    if ev is not None:
+                        self._sse_event(ev[0], ev[1])
+                        continue
+                    if done() and channel.empty():
+                        break
+                self._sse_event(*finish())
+            # lint-allow[swallowed-exception]: a mid-stream client disconnect strands no one — the engine side resolves the request future and journals the outcome regardless; there is just no socket left to tell
+            except OSError:
+                # client went away mid-stream: the request completes (and
+                # journals) regardless; there is just no one to tell
+                logger.info("streaming client disconnected (%s)", self._rid)
+            finally:
+                metrics.observe_stream_open(-1)
+
+        @staticmethod
+        def _stream_error_event(e: Exception) -> tuple[str, dict]:
+            """The ONE exception -> terminal SSE error event mapping, shared
+            by the generate and summarize stream paths (mirrors the typed
+            non-streaming contract: shed reason + Retry-After hint,
+            supervised failure class, raw error)."""
+            if isinstance(e, RequestShed):
+                return "error", {
+                    "error": "shed", "reason": e.reason.value,
+                    "retry_after_s": e.retry_after_s or 1.0,
+                }
+            if isinstance(e, RequestFailed):
+                return "error", {"error": "request_failed",
+                                 "class": e.failure_class.value,
+                                 "detail": str(e)}
+            return "error", {"error": str(e)}
+
+        def _stream_finish_generate(self, fut):
+            """Terminal SSE event for a streamed /v1/generate: the exact
+            non-streaming payload on success, a typed error event
+            otherwise."""
+            try:
+                c = fut.result()
+            except Exception as e:
+                return self._stream_error_event(e)
+            return "done", {
+                "request_id": self._rid,
+                "completions": [{"text": c.text,
+                                 "record": c.record.to_dict()}],
+            }
 
         def do_POST(self) -> None:  # noqa: N802 (stdlib API)
             self._rid = None  # keep-alive: one handler serves many requests
@@ -640,6 +789,23 @@ def make_handler(state: ServeState):
             except _BadRequest as e:
                 self._json({"error": str(e)}, 400)
                 return
+            qos = self._qos_class()
+            if qos is None:
+                return
+            tenant, tier = qos
+            if self._stream_requested(req):
+                if len(prompts) != 1:
+                    self._json(
+                        {"error": "'stream' needs exactly one prompt"}, 400
+                    )
+                    return
+                self._generate_stream(
+                    prompts[0], max_new_tokens, config, deadline,
+                    references[0] if references else None,
+                    cache_hints[0] if cache_hints else None,
+                    tenant, tier,
+                )
+                return
             # one RequestTrace for the whole HTTP request: multi-prompt
             # calls put each prompt's spans on its own sub-track
             trace = (
@@ -659,6 +825,8 @@ def make_handler(state: ServeState):
                     # this handler made the sampling decision (trace may be
                     # None = sampled out) — the scheduler must not re-draw
                     trace_owned=True,
+                    tenant=tenant,
+                    tier=tier,
                 )
             except RequestShed as e:
                 if state.obs is not None:
@@ -692,6 +860,51 @@ def make_handler(state: ServeState):
                 }
             )
 
+        def _generate_stream(self, prompt, max_new_tokens, config, deadline,
+                             reference, cache_hint, tenant, tier) -> None:
+            """Streamed /v1/generate: the request rides the scheduler like
+            any other, plus a StreamChannel the in-flight harvest pushes
+            decode-progress deltas into at every segment boundary (the
+            one-shot path emits one final delta). Concatenated deltas are
+            byte-identical to the done event's text — the stream.py delta
+            discipline. Admission sheds happen BEFORE the stream opens and
+            answer as plain typed 429s."""
+            from .stream import StreamChannel
+
+            trace = (
+                state.obs.start_request(self._rid)
+                if state.obs is not None else None
+            )
+            channel = StreamChannel(self._rid)
+            try:
+                fut = state.scheduler.submit(
+                    prompt,
+                    max_new_tokens=max_new_tokens,
+                    config=config,
+                    deadline=deadline,
+                    reference=reference,
+                    cache_hint=cache_hint,
+                    trace=trace,
+                    trace_id=self._rid,
+                    # this handler made the sampling decision (trace may be
+                    # None = sampled out) — the scheduler must not re-draw
+                    trace_owned=True,
+                    tenant=tenant,
+                    tier=tier,
+                    stream=channel,
+                )
+            except RequestShed as e:
+                if state.obs is not None:
+                    state.obs.finish_request(trace, f"shed:{e.reason.value}")
+                self._shed_response(e)
+                return
+            self._stream_response(
+                channel, fut.done, lambda: self._stream_finish_generate(fut)
+            )
+            if state.obs is not None:
+                status = "ok" if not fut.exception() else "error"
+                state.obs.finish_request(trace, status)
+
         def _summarize(self) -> None:
             req = self._read_json()
             if req is None:
@@ -716,6 +929,10 @@ def make_handler(state: ServeState):
             except _BadRequest as e:
                 self._json({"error": str(e)}, 400)
                 return
+            qos = self._qos_class()
+            if qos is None:
+                return
+            tenant, tier = qos
             # the trace survives every strategy round: all the request's
             # fanned-out prompts record onto it through the QueuedBackend
             trace = (
@@ -723,22 +940,56 @@ def make_handler(state: ServeState):
                 if state.obs is not None else None
             )
             qbackend = state.scheduler.backend_view(
-                deadline=deadline, trace=trace, trace_id=self._rid
+                deadline=deadline, trace=trace, trace_id=self._rid,
+                tenant=tenant, tier=tier,
             )
             t0 = time.monotonic()
+
+            def payload_from(result) -> dict:
+                recs = qbackend.records
+                return {
+                    "approach": approach,
+                    "summary": clean_thinking_tokens(result.summary),
+                    "num_chunks": result.num_chunks,
+                    "llm_calls": result.llm_calls,
+                    "serving": {
+                        "llm_requests": len(recs),
+                        "queue_wait_s": round(sum(r.queue_wait_s for r in recs), 6),
+                        "engine_s": round(sum(r.engine_s for r in recs), 6),
+                        "generated_tokens": sum(r.generated_tokens for r in recs),
+                        "draft_tokens": sum(r.draft_tokens for r in recs),
+                        "accepted_tokens": sum(r.accepted_tokens for r in recs),
+                        "total_s": round(time.monotonic() - t0, 6),
+                    },
+                }
+
             try:
                 # request-level admission: the strategy's rounds fan out as
                 # INTERNAL submits that bypass the depth budget (a wide map
                 # round must not shed itself on an idle server), so the
-                # queue/token gate applies here, once, per request; the
+                # queue/token gate applies here, once, per request — and it
+                # bills the whole document against the tenant's quota. The
                 # full-document tokenization is only worth paying when a
-                # token budget is actually configured
+                # token budget or a tenant table is actually configured
                 est_tokens = (
                     state.backend.count_tokens(text)
                     if state.scheduler.queue.max_queued_tokens
+                    or state.tenants is not None
                     else 0
                 )
-                state.scheduler.check_admission(est_tokens)
+                state.scheduler.check_admission(est_tokens, tenant)
+            except RequestShed as e:
+                if state.obs is not None:
+                    state.obs.finish_request(trace, f"shed:{e.reason.value}")
+                self._shed_response(e)
+                return
+            if self._stream_requested(req):
+                self._summarize_stream(
+                    text, approach, max_new_tokens, qbackend, trace,
+                    payload_from,
+                )
+                return
+            try:
                 strategy = state.strategy_for(approach, max_new_tokens)
                 result = strategy.summarize(text, backend=qbackend)
             except RequestShed as e:
@@ -762,24 +1013,65 @@ def make_handler(state: ServeState):
                 return
             if state.obs is not None:
                 state.obs.finish_request(trace, "ok")
-            recs = qbackend.records
-            self._json(
-                {
-                    "approach": approach,
-                    "summary": clean_thinking_tokens(result.summary),
-                    "num_chunks": result.num_chunks,
-                    "llm_calls": result.llm_calls,
-                    "serving": {
-                        "llm_requests": len(recs),
-                        "queue_wait_s": round(sum(r.queue_wait_s for r in recs), 6),
-                        "engine_s": round(sum(r.engine_s for r in recs), 6),
-                        "generated_tokens": sum(r.generated_tokens for r in recs),
-                        "draft_tokens": sum(r.draft_tokens for r in recs),
-                        "accepted_tokens": sum(r.accepted_tokens for r in recs),
-                        "total_s": round(time.monotonic() - t0, 6),
-                    },
-                }
+            self._json(payload_from(result))
+
+        def _summarize_stream(self, text, approach, max_new_tokens,
+                              qbackend, trace, payload_from) -> None:
+            """Streamed /v1/summarize: the strategy runs on a worker thread
+            while this handler streams SSE. Deltas here are PROGRESS events
+            (one per completed strategy round — a summarize's token stream
+            would interleave its map fan-out); the done event carries the
+            exact non-streaming reply payload."""
+            import threading
+
+            from .stream import StreamChannel
+
+            channel = StreamChannel(self._rid)
+            metrics = state.scheduler.metrics
+            metrics.observe_stream_request()
+
+            def progress(done_prompts: int) -> None:
+                channel.push_event("progress", {
+                    "llm_requests_done": done_prompts,
+                })
+
+            qbackend.progress = progress
+            box: dict = {}
+
+            def run() -> None:
+                try:
+                    strategy = state.strategy_for(approach, max_new_tokens)
+                    box["result"] = strategy.summarize(text, backend=qbackend)
+                # lint-allow[swallowed-exception]: the error is delivered, not swallowed — finish() reads the box and renders it as the stream's typed terminal error event
+                except Exception as e:
+                    box["error"] = e
+
+            worker = threading.Thread(
+                target=run, name="vnsum-serve-stream-summarize", daemon=True
             )
+            worker.start()
+
+            def finish():
+                worker.join()
+                e = box.get("error")
+                if e is None:
+                    return "done", {"request_id": self._rid,
+                                    **payload_from(box["result"])}
+                logger.error("streamed summarize failed: %s", e)
+                return self._stream_error_event(e)
+
+            self._stream_response(
+                channel, lambda: not worker.is_alive(), finish
+            )
+            # a client disconnect skips finish() (nobody to write to), but
+            # the strategy run still owns the trace: wait it out before
+            # finalizing, so spans never land on a finished trace and the
+            # recorded status reflects the run's real outcome
+            worker.join()
+            if state.obs is not None:
+                state.obs.finish_request(
+                    trace, "error" if box.get("error") is not None else "ok"
+                )
 
         def log_message(self, fmt, *args):  # route through our logger
             logger.info("%s %s", self.address_string(), fmt % args)
@@ -883,6 +1175,19 @@ def main(argv: list[str] | None = None) -> int:
                    help="group-commit fsync interval; every record is "
                         "flushed to the kernel regardless (SIGKILL-safe), "
                         "this only bounds the power-loss window")
+    p.add_argument("--tenants", default=None,
+                   help="multi-tenant QoS (serve/qos.py): comma-separated "
+                        "name:weight:token_rate[:tier] declarations, e.g. "
+                        "'interactive:8:0,batch:1:500:batch'. Requests pick "
+                        "their tenant via the X-Tenant header (missing = "
+                        "'default', unknown = typed 400). Arms weighted-"
+                        "fair scheduling, token-rate quotas (typed 429 "
+                        "QUOTA + Retry-After), and — with --inflight — "
+                        "preemption of batch-tier slots for interactive "
+                        "work")
+    p.add_argument("--preempt-budget", type=int, default=16,
+                   help="max lifetime preemptions per batch-tier request "
+                        "before it becomes non-evictable (starvation bound)")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="graceful-shutdown drain budget before queued and "
                         "in-flight requests are shed typed")
@@ -893,6 +1198,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="fake backend: fixed per-dispatch latency")
     p.add_argument("--fake-per-prompt-ms", type=float, default=0.0,
                    help="fake backend: marginal per-prompt latency")
+    p.add_argument("--fake-segment-overhead-ms", type=float, default=0.0,
+                   help="fake backend: per-decode-segment latency (the "
+                        "in-flight chaos/QoS soaks need segments that take "
+                        "real time so kills and preemptions land mid-decode)")
+    p.add_argument("--fake-per-step-ms", type=float, default=0.0,
+                   help="fake backend: per-decode-step latency (both paths)")
     args = p.parse_args(argv)
 
     cache_blocks = 0 if args.no_prefix_cache else args.cache_blocks
@@ -936,7 +1247,19 @@ def main(argv: list[str] | None = None) -> int:
             "fake", spec_k=args.spec_k, prefix_cache_blocks=cache_blocks,
             batch_overhead_s=args.fake_batch_overhead_ms / 1000.0,
             per_prompt_s=args.fake_per_prompt_ms / 1000.0,
+            segment_overhead_s=args.fake_segment_overhead_ms / 1000.0,
+            per_step_s=args.fake_per_step_ms / 1000.0,
         )
+
+    tenants = None
+    if args.tenants:
+        from .qos import TenantTable, parse_tenant_specs
+
+        try:
+            tenants = TenantTable(parse_tenant_specs(args.tenants))
+        # lint-allow[swallowed-exception]: p.error raises SystemExit(2) — the CLI-error path, nothing to resolve
+        except ValueError as e:
+            p.error(f"--tenants {args.tenants!r}: {e}")
 
     supervisor = None
     if not args.no_supervise:
@@ -968,7 +1291,10 @@ def main(argv: list[str] | None = None) -> int:
         journal_dir=args.journal_dir,
         journal_fsync_s=args.journal_fsync_ms / 1000.0,
         mesh=mesh,
+        tenants=tenants,
     )
+    if args.inflight:
+        state.scheduler.preempt_budget = max(args.preempt_budget, 1)
     # crash recovery BEFORE accepting new traffic: unfinished journaled
     # requests re-enqueue (the scheduler thread is already live, so replay
     # dispatch overlaps server bring-up)

@@ -1,0 +1,80 @@
+"""Per-request streaming emit channel: scheduler harvest -> HTTP response.
+
+The in-flight slot loop already surfaces every segment boundary to the host
+(serve/inflight.py::_run_segment) — streaming is "only" the plumbing from
+that boundary to the client socket. A :class:`StreamChannel` is that pipe:
+the SCHEDULER thread pushes text snapshots as a request's decode advances
+(and the harvest's final text at completion), the HTTP handler thread pops
+delta events and writes them as SSE frames. The channel never blocks the
+scheduler: pushes are queue puts, and a slow/disconnected client only grows
+its own channel, never a decode segment.
+
+Delta discipline — what makes ``"".join(deltas) == final_text`` a hard
+invariant rather than a hope:
+
+- ``push_text`` takes the FULL decoded text so far and emits only the
+  suffix beyond what was already emitted;
+- a snapshot that does not extend the emitted prefix (preemption restarted
+  the request from scratch; a tokenizer boundary re-rendered a partial
+  piece) emits NOTHING — emission resumes once decode re-passes the
+  high-water mark, and the completion push flushes whatever remains;
+- the completion's text goes through the same path, so the concatenation
+  identity holds for every request, including preempted-and-requeued ones.
+
+The channel carries no terminal sentinel: the HTTP layer already holds the
+request future (or the summarize worker thread) and drains the channel
+after it resolves — resolution ordering in the scheduler (deltas pushed
+BEFORE the future) makes that race-free.
+"""
+from __future__ import annotations
+
+import queue
+
+
+class StreamChannel:
+    """One request's emit channel. Producer: the scheduler thread (pushes
+    are in dispatch/harvest order). Consumer: the HTTP handler thread."""
+
+    def __init__(self, request_id: str = "") -> None:
+        self.request_id = request_id
+        self._q: queue.Queue = queue.Queue()
+        # producer-side high-water mark of emitted text; scheduler-thread
+        # only, like the rest of the engine-side request state
+        self._sent = ""
+        self.events_pushed = 0
+
+    # -- producer side (scheduler thread) ---------------------------------
+
+    def push_text(self, text_so_far: str) -> bool:
+        """Emit the suffix of ``text_so_far`` beyond what was already
+        emitted; returns True when a delta actually left. Non-extending
+        snapshots (preemption restart, re-rendered partial detok) emit
+        nothing — see the module docstring's delta discipline."""
+        if (
+            not text_so_far
+            or not text_so_far.startswith(self._sent)
+            or len(text_so_far) <= len(self._sent)
+        ):
+            return False
+        delta = text_so_far[len(self._sent):]
+        self._sent = text_so_far
+        self.events_pushed += 1
+        self._q.put(("delta", {"text": delta}))
+        return True
+
+    def push_event(self, kind: str, payload: dict) -> None:
+        """Out-of-band event (summarize round progress etc.)."""
+        self.events_pushed += 1
+        self._q.put((kind, dict(payload)))
+
+    # -- consumer side (HTTP handler thread) ------------------------------
+
+    def pop(self, timeout_s: float) -> tuple[str, dict] | None:
+        try:
+            return self._q.get(timeout=timeout_s)
+        # lint-allow[swallowed-exception]: an empty poll IS the answer — the caller re-checks the request future and keeps draining
+        except queue.Empty:
+            return None
+
+    def empty(self) -> bool:
+        return self._q.empty()

@@ -46,13 +46,16 @@ def free_port() -> int:
 
 
 def http_json(method: str, host: str, port: int, path: str,
-              payload: dict | None = None, timeout: float = 30.0):
-    """One HTTP round trip -> (status, parsed JSON body | None)."""
+              payload: dict | None = None, timeout: float = 30.0,
+              headers: dict | None = None):
+    """One HTTP round trip -> (status, parsed JSON body | None).
+    ``headers`` adds/overrides request headers (the QoS soak's X-Tenant)."""
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
         body = json.dumps(payload) if payload is not None else None
         conn.request(method, path, body=body,
-                     headers={"Content-Type": "application/json"})
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
         resp = conn.getresponse()
         raw = resp.read()
         try:
@@ -145,15 +148,25 @@ class KillSchedule:
     the three regimes the acceptance criteria name: an early kill (load
     just started — requests are mid-prefill), a late kill (the batch is
     deep in decode), and a drain kill (SIGTERM received, drain underway,
-    then SIGKILL)."""
+    then SIGKILL). With ``qos=True`` the shape swaps one mid_load for a
+    ``mid_preempt`` kill: same SIGKILL-under-load mechanics, but the server
+    runs with a widened eviction->PREEMPTED-journal gap
+    (VNSUM_CHAOS_PREEMPT_GAP_MS) so the kill lands inside the preemption
+    window the ledger invariant must survive. Non-qos schedules are
+    bit-identical to their pre-QoS draws (same seed -> same soak)."""
 
     def __init__(self, seed: int, kills: int = 3,
-                 load_window_s: float = 1.5) -> None:
+                 load_window_s: float = 1.5, qos: bool = False) -> None:
         self.seed = seed
         rng = random.Random(seed)
-        kinds = ["mid_load", "mid_load", "mid_drain"]
+        kinds = (
+            ["mid_preempt", "mid_load", "mid_drain"] if qos
+            else ["mid_load", "mid_load", "mid_drain"]
+        )
         while len(kinds) < kills:
-            kinds.append(rng.choice(["mid_load", "mid_drain"]))
+            kinds.append(rng.choice(
+                ["mid_load", "mid_drain"] + (["mid_preempt"] if qos else [])
+            ))
         rng.shuffle(kinds)
         self.points = [
             KillPoint(
