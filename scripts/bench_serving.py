@@ -784,6 +784,228 @@ def journal_phase(args) -> dict:
     }
 
 
+def cancel_phase(args) -> dict:
+    """Request-cancellation phase (ISSUE 13 tentpole), two claims:
+
+    (a) RECLAIM — with a batch tenant saturating the in-flight slots with
+    long decodes, cancelling its outstanding requests (DELETE, the gang
+    surface) hands the engine back to the remaining interactive clients
+    within one segment boundary: their post-cancel goodput must recover to
+    >=90% of an idle-arm baseline measured with no batch tenant at all.
+
+    (b) UNUSED-PATH OVERHEAD — the cancel machinery's cost when nobody
+    cancels: the r04 mixed in-flight closed loop with the per-boundary
+    cancel sweeps enabled vs disabled (the scheduler's bench-only
+    ``cancellation_enabled`` lever), best-of-2 per arm like the journal
+    phase; the enabled arm must stay within the overhead bar (<1% is the
+    acceptance target — the armed fast path is two attribute reads per
+    segment boundary)."""
+    from vnsum_tpu.serve.qos import TenantTable, parse_tenant_specs
+    from vnsum_tpu.testing.chaos import http_delete
+
+    slots = 4
+    window_s = args.cancel_window_s
+    backend_kw = dict(batch_overhead_s=0.004, segment_words=2,
+                      segment_overhead_s=0.008, per_slot_segment_s=0.001)
+    # interactive: 8-word outputs (4 segments); batch: 40-word outputs
+    # (20 segments) — the long decodes whose cancellation frees the slots
+    i_prompt = "cau hoi ngan can tra loi nhanh gon"
+    b_prompt = "phan tich day du va chi tiet ve moi mat cua van de " * 10
+
+    def make_state():
+        return ServeState(
+            FakeBackend(**backend_kw),
+            max_batch=slots, max_wait_s=0.005, max_queue_depth=256,
+            trace_sample=0.0, inflight=True, slots=slots,
+            tenants=TenantTable(parse_tenant_specs(
+                "interactive:8:0,batch:1:0:batch"
+            )),
+        )
+
+    def run_interactive(base, stop, stamps, n_clients=4):
+        """Closed-loop interactive clients; completion times -> stamps."""
+        def client(cid):
+            c = Client(base)
+            c.connect()
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    status, _ = c.post(
+                        "/v1/generate", {"prompt": i_prompt},
+                        headers={"X-Tenant": "interactive"},
+                    )
+                except Exception:
+                    break
+                if status == 200:
+                    stamps.append(time.monotonic())
+            c.close()
+        threads = [threading.Thread(target=client, args=(cid,), daemon=True)
+                   for cid in range(n_clients)]
+        for t in threads:
+            t.start()
+        return threads
+
+    def rate_in(stamps, t0, t1) -> float:
+        n = sum(1 for t in list(stamps) if t0 <= t < t1)
+        return n / (t1 - t0) if t1 > t0 else 0.0
+
+    # -- idle baseline: interactive clients alone -------------------------
+    state = make_state()
+    server = make_server(state, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    stop = threading.Event()
+    stamps: list[float] = []
+    threads = run_interactive(base, stop, stamps)
+    time.sleep(0.3)  # warmup
+    t0 = time.monotonic()
+    time.sleep(window_s)
+    idle_rate = rate_in(stamps, t0, time.monotonic())
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    server.shutdown()
+    server.server_close()
+    state.close()
+
+    # -- loaded arm: batch saturation, then gang-cancel -------------------
+    state = make_state()
+    server = make_server(state, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    u = urllib.parse.urlparse(base)
+    stop = threading.Event()
+    stop_batch = threading.Event()
+    stamps = []
+    in_flight: dict[int, str] = {}  # bid -> rid currently posted
+    flight_lock = threading.Lock()
+
+    def batch_client(bid):
+        c = Client(base)
+        c.connect()
+        n = 0
+        while not stop_batch.is_set():
+            n += 1
+            rid = f"bench-batch-{bid}-{n}"
+            with flight_lock:
+                in_flight[bid] = rid
+            try:
+                c.post("/v1/generate",
+                       {"prompt": b_prompt, "request_id": rid},
+                       headers={"X-Tenant": "batch"})
+            except Exception:
+                break
+            with flight_lock:
+                in_flight.pop(bid, None)
+        c.close()
+
+    batch_threads = [
+        threading.Thread(target=batch_client, args=(bid,), daemon=True)
+        for bid in range(args.cancel_batch_clients)
+    ]
+    for t in batch_threads:
+        t.start()
+    time.sleep(0.3)  # reach saturation
+    threads = run_interactive(base, stop, stamps)
+    t_loaded = time.monotonic()
+    time.sleep(window_s)
+    # THE CANCEL: stop the tenant's submissions and DELETE everything it
+    # still has in flight (two sweeps catch posts racing the first)
+    t_cancel = time.monotonic()
+    stop_batch.set()
+    for _sweep in range(2):
+        with flight_lock:
+            rids = list(in_flight.values())
+        for rid in rids:
+            try:
+                http_delete(u.hostname, u.port, f"/v1/requests/{rid}",
+                            timeout=5.0)
+            except OSError:
+                pass  # lint-allow[swallowed-exception]: a lost DELETE just leaves that job to finish; the recovery ratio below is the judge
+        time.sleep(0.05)
+    loaded_rate = rate_in(stamps, t_loaded, t_cancel)
+    t_rec = time.monotonic()
+    time.sleep(window_s)
+    recovered_rate = rate_in(stamps, t_rec, time.monotonic())
+    stop.set()
+    for t in threads + batch_threads:
+        t.join(timeout=10)
+    server.shutdown()
+    server.server_close()
+    snap = state.scheduler.metrics.snapshot()
+    state.close()
+
+    # -- unused-path overhead A/B -----------------------------------------
+    short = "tin ngan gon sau day chi tam tu"
+    long_ = "phan tich chuyen sau ve tinh hinh kinh te xa hoi " * 6
+
+    def payload(cid, i):
+        return {"prompt": short if (cid + i) % 2 else long_,
+                "deadline_ms": args.deadline_s * 1000}
+
+    arms = {}
+    for name, enabled in (("cancel_on", True), ("cancel_off", False)):
+        best = None
+        for _rep in range(2):
+            backend = FakeBackend(
+                batch_overhead_s=args.inflight_prefill_s,
+                per_step_s=args.per_step_s,
+                segment_words=args.segment_words,
+                segment_overhead_s=args.segment_overhead_s,
+                per_slot_segment_s=args.per_slot_segment_s,
+            )
+            ab_state = ServeState(
+                backend, max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1000.0, max_queue_depth=64,
+                trace_sample=0.0, inflight=True, slots=args.max_batch,
+            )
+            # bench-only lever: measure the armed fast path against the
+            # same build with the sweeps compiled out of the loop
+            ab_state.scheduler.cancellation_enabled = enabled
+            ab_server = make_server(ab_state, "127.0.0.1", 0)
+            threading.Thread(
+                target=ab_server.serve_forever, daemon=True
+            ).start()
+            ab_base = f"http://127.0.0.1:{ab_server.server_address[1]}"
+            loop = closed_loop(
+                ab_base, args.clients, args.per_client, args.deadline_s,
+                payload,
+            )
+            ab_server.shutdown()
+            ab_server.server_close()
+            ab_state.close()
+            if best is None or loop["goodput_rps"] > best["goodput_rps"]:
+                best = loop
+        arms[name] = best
+    on, off = arms["cancel_on"], arms["cancel_off"]
+    overhead_pct = (
+        round((off["goodput_rps"] - on["goodput_rps"])
+              / off["goodput_rps"] * 100.0, 2)
+        if off["goodput_rps"] else 0.0
+    )
+    return {
+        "workload": f"reclaim: 4 interactive clients vs "
+                    f"{args.cancel_batch_clients} batch clients saturating "
+                    f"{slots} slots with 20-segment decodes; at t_cancel "
+                    "the batch tenant stops and its in-flight requests are "
+                    "DELETEd — post-cancel interactive goodput vs an "
+                    "idle-arm baseline. Overhead: r04 mixed in-flight "
+                    "closed loop, cancel sweeps on vs off, best-of-2",
+        "idle_goodput_rps": round(idle_rate, 2),
+        "loaded_goodput_rps": round(loaded_rate, 2),
+        "recovered_goodput_rps": round(recovered_rate, 2),
+        "recovery_ratio": (
+            round(recovered_rate / idle_rate, 3) if idle_rate else 0.0
+        ),
+        "cancels": dict(snap.cancelled),
+        "preemptions": snap.preemptions,
+        "cancel_on": on,
+        "cancel_off": off,
+        "cancel_overhead_pct": overhead_pct,
+    }
+
+
 # -- main --------------------------------------------------------------------
 
 
@@ -849,7 +1071,21 @@ def main(argv=None) -> int:
                         "anchored TTFT p99 under batch saturation degrades "
                         "more than this percentage vs its unloaded "
                         "baseline (CI smoke passes a softer floor)")
-    p.add_argument("--out", default="BENCH_serving_r07.json")
+    # cancellation phase knobs (cancel API + slot reclamation)
+    p.add_argument("--cancel-window-s", type=float, default=2.0,
+                   help="cancel phase: measurement window per regime "
+                        "(idle / loaded / post-cancel)")
+    p.add_argument("--cancel-batch-clients", type=int, default=8)
+    p.add_argument("--cancel-min-recovery", type=float, default=0.9,
+                   help="exit non-zero when post-cancel interactive "
+                        "goodput recovers below this ratio of the idle "
+                        "baseline (CI smoke passes a softer floor)")
+    p.add_argument("--cancel-max-overhead-pct", type=float, default=1.0,
+                   help="exit non-zero when the unused cancel machinery "
+                        "costs more than this percentage of goodput "
+                        "(sweeps on vs off, best-of-2; CI smoke passes a "
+                        "softer floor for shared-runner jitter)")
+    p.add_argument("--out", default="BENCH_serving_r08.json")
     p.add_argument("--min-speedup", type=float, default=4.0,
                    help="exit non-zero below this goodput ratio (CI smoke "
                         "passes a softer floor: shared 2-core runners get "
@@ -979,6 +1215,10 @@ def main(argv=None) -> int:
     print("qos phase ...", flush=True)
     qos = qos_phase(args)
 
+    # 10) cancellation: slot reclaim on gang-cancel + unused-path overhead
+    print("cancel phase ...", flush=True)
+    cancel = cancel_phase(args)
+
     speedup = (
         serve_closed["goodput_rps"] / serial_closed["goodput_rps"]
         if serial_closed["goodput_rps"]
@@ -1018,6 +1258,7 @@ def main(argv=None) -> int:
         "journal": journal,
         "sharded": sharded,
         "qos": qos,
+        "cancel": cancel,
         "serving_stats": stats.to_dict(),
         # server-side histogram snapshots (vnsum_tpu.obs): bucket counts
         # plus bucket-derived p50/p95/p99 for queue wait, TTFT, e2e latency,
@@ -1073,6 +1314,13 @@ def main(argv=None) -> int:
         f"degradation), {qos['loaded']['preemptions']} preemptions / "
         f"{qos['loaded']['batch_completed']} batch jobs completed"
     )
+    print(
+        f"cancel: interactive goodput {cancel['loaded_goodput_rps']} rps "
+        f"under batch saturation -> {cancel['recovered_goodput_rps']} rps "
+        f"after gang-cancel (x{cancel['recovery_ratio']} of the "
+        f"{cancel['idle_goodput_rps']} rps idle baseline); unused-path "
+        f"overhead {cancel['cancel_overhead_pct']}%"
+    )
     print(f"wrote {args.out}")
     ok = (
         speedup >= args.min_speedup
@@ -1090,6 +1338,12 @@ def main(argv=None) -> int:
         # (a run that never preempted proved nothing)
         and qos["interactive_ttft_p99_degradation_pct"] <= args.qos_max_ttft_pct
         and qos["loaded"]["preemptions"] > 0
+        # cancellation: the gang-cancel must hand the engine back (and
+        # have actually cancelled something), and the machinery must be
+        # ~free when unused
+        and cancel["recovery_ratio"] >= args.cancel_min_recovery
+        and sum(cancel["cancels"].values()) > 0
+        and cancel["cancel_overhead_pct"] <= args.cancel_max_overhead_pct
     )
     return 0 if ok else 1
 

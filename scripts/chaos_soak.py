@@ -54,7 +54,9 @@ from vnsum_tpu.testing.chaos import (  # noqa: E402
     KillSchedule,
     ServerProcess,
     free_port,
+    http_delete,
     http_json,
+    sse_stream,
 )
 
 # the load: unique deterministic Vietnamese-shaped prompts; half the
@@ -171,6 +173,8 @@ class LoadDriver:
 
 
 def scrape_metric(port: int, name: str) -> int | None:
+    """One /metrics scrape -> the integer value of ``name`` (labels
+    allowed verbatim, e.g. ``..._total{stage="queued"}``), or None."""
     import http.client
 
     try:
@@ -182,6 +186,380 @@ def scrape_metric(port: int, name: str) -> int | None:
         return None
     m = re.search(rf"^{re.escape(name)} (\d+)", text, re.M)
     return int(m.group(1)) if m else None
+
+
+# -- client-churn soak (--churn): cancels/disconnects, no process kills ------
+
+
+class ChurnDriver:
+    """Seeded client churn against a live in-flight server: every request
+    draws one behavior — complete normally (plain or streamed), DELETE
+    itself mid-flight (instantly = mid-queue-biased, or after a delay =
+    mid-slot-biased), or open a stream and drop the socket mid-decode.
+    Odd clients ride the preemptible batch tenant, even ones interactive,
+    so tier preemption runs underneath the churn the whole time."""
+
+    MODES = ("plain", "stream_full", "cancel_fast", "cancel_slow",
+             "stream_abandon")
+    WEIGHTS = (0.30, 0.20, 0.15, 0.20, 0.15)
+
+    def __init__(self, port: int, clients: int, per_client: int,
+                 seed: int) -> None:
+        self.port = port
+        self.clients = clients
+        self.per_client = per_client
+        self.seed = seed
+        self._lock = threading.Lock()
+        self.attempted: dict[str, str] = {}     # rid -> prompt
+        self.completed: dict[str, str] = {}     # rid -> text (client saw it)
+        self.churned: set[str] = set()          # rid -> cancelled/abandoned
+        self.mode_counts: dict[str, int] = {}
+        self.identity_failures: list[str] = []  # streamed deltas != done
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def _headers(self, cid: int) -> dict:
+        return {"X-Tenant": "batch" if cid % 2 else "interactive"}
+
+    def _rid(self, cid: int, i: int) -> str:
+        return f"churn-{cid}-{i}"
+
+    def _client(self, cid: int) -> None:
+        import random
+
+        rng = random.Random(self.seed * 1000 + cid)
+        for i in range(self.per_client):
+            if self._stop.is_set():
+                return
+            mode = rng.choices(self.MODES, weights=self.WEIGHTS)[0]
+            rid = self._rid(cid, i)
+            payload = {"prompt": make_prompt(cid, i), "request_id": rid}
+            if (cid + i) % 2:
+                payload.update({"temperature": 0.0,
+                                "seed": cid * 1000 + i})
+            with self._lock:
+                self.attempted[rid] = payload["prompt"]
+                self.mode_counts[mode] = self.mode_counts.get(mode, 0) + 1
+            try:
+                self._one(cid, i, rng, mode, rid, payload)
+            except OSError:
+                time.sleep(0.1)  # server hiccup: this request is forfeit
+
+    def _one(self, cid, i, rng, mode, rid, payload) -> None:
+        headers = self._headers(cid)
+        if mode == "plain":
+            status, body = http_json(
+                "POST", "127.0.0.1", self.port, "/v1/generate",
+                payload, timeout=30.0, headers=headers,
+            )
+            if status == 200 and body and body.get("completions"):
+                with self._lock:
+                    self.completed[rid] = body["completions"][0]["text"]
+        elif mode == "stream_full":
+            status, events = sse_stream(
+                "127.0.0.1", self.port, "/v1/generate",
+                {**payload, "stream": True}, headers=headers,
+            )
+            if status != 200 or not events or events[-1][0] != "done":
+                return
+            done = events[-1][1]
+            text = done["completions"][0]["text"]
+            deltas = "".join(p["text"] for n, p in events if n == "delta")
+            if deltas != text:
+                with self._lock:
+                    self.identity_failures.append(rid)
+            with self._lock:
+                self.completed[rid] = text
+        elif mode in ("cancel_fast", "cancel_slow"):
+            # DELETE from a side thread while the POST blocks: fast draws
+            # bias mid-queue/mid-prefill, slow draws mid-slot/mid-decode
+            delay = (rng.uniform(0.0, 0.02) if mode == "cancel_fast"
+                     else rng.uniform(0.06, 0.25))
+            with self._lock:
+                self.churned.add(rid)
+
+            def cancel_later():
+                time.sleep(delay)
+                try:
+                    http_delete("127.0.0.1", self.port,
+                                f"/v1/requests/{rid}")
+                except OSError:
+                    pass  # lint-allow[swallowed-exception]: the POST side still resolves the request; a lost DELETE just means this draw degraded to a plain request
+
+            t = threading.Thread(target=cancel_later, daemon=True)
+            t.start()
+            status, body = http_json(
+                "POST", "127.0.0.1", self.port, "/v1/generate",
+                payload, timeout=30.0, headers=headers,
+            )
+            t.join(timeout=10)
+            if status == 200 and body and body.get("completions"):
+                # the cancel lost the completion race — legal; the ledger
+                # must then say COMPLETE and byte-match like any survivor
+                with self._lock:
+                    self.completed[rid] = body["completions"][0]["text"]
+        else:  # stream_abandon
+            with self._lock:
+                self.churned.add(rid)
+            sse_stream(
+                "127.0.0.1", self.port, "/v1/generate",
+                {**payload, "stream": True},
+                abandon_after=rng.randint(1, 3), headers=headers,
+            )
+
+    def start(self) -> None:
+        self._threads = [
+            threading.Thread(target=self._client, args=(cid,), daemon=True)
+            for cid in range(self.clients)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def join(self, timeout_s: float) -> bool:
+        t_end = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(t_end - time.monotonic(), 0.1))
+        return not any(t.is_alive() for t in self._threads)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _churn_stage_probes(port: int) -> dict:
+    """Deterministic stage coverage on top of the random churn: pin each
+    lifecycle stage with a dedicated scenario so the acceptance assertions
+    never depend on a lucky draw. Returns the probe bookkeeping (rids per
+    scenario) for the offline audit."""
+    long_prompt = " ".join(f"tai lieu dai {k}" for k in range(120))
+    probes = {"resident": [], "queued": [], "preempt_cancel": []}
+
+    def submit_bg(rid: str, tenant: str):
+        def run():
+            try:
+                http_json("POST", "127.0.0.1", port, "/v1/generate",
+                          {"prompt": long_prompt, "request_id": rid},
+                          timeout=30.0, headers={"X-Tenant": tenant})
+            except OSError:
+                pass  # lint-allow[swallowed-exception]: the server resolves the request either way; the probe audits the LEDGER, not this socket
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t
+
+    # (a) saturate all 4 slots with batch-tier work, cancel one RESIDENT
+    fillers = [submit_bg(f"probe-res-{k}", "batch") for k in range(4)]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if scrape_metric(port, "vnsum_serve_slots_busy") == 4:
+            break
+        time.sleep(0.02)
+    probes["resident"].append("probe-res-0")
+    http_delete("127.0.0.1", port, "/v1/requests/probe-res-0")
+    # (b) with slots still saturated, a 5th request must QUEUE — cancel it
+    queued_t = submit_bg("probe-q-0", "interactive")
+    time.sleep(0.03)
+    probes["queued"].append("probe-q-0")
+    http_delete("127.0.0.1", port, "/v1/requests/probe-q-0")
+    # (c) mid-preemption: an interactive burst evicts the remaining batch
+    # residents (the widened eviction->journal gap keeps the window open).
+    # Wait for the preemption counter to actually move — a DELETE fired
+    # before the eviction would cancel the victim as a plain resident and
+    # prove nothing about the preempt->cancel window — then cancel the
+    # victims while they sit preempted/requeued
+    preempts_before = scrape_metric(
+        port, "vnsum_serve_qos_preemptions_total") or 0
+    burst = [submit_bg(f"probe-burst-{k}", "interactive") for k in range(6)]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        n = scrape_metric(port, "vnsum_serve_qos_preemptions_total")
+        if n is not None and n > preempts_before:
+            break
+        time.sleep(0.01)
+    for k in range(1, 4):
+        rid = f"probe-res-{k}"
+        probes["preempt_cancel"].append(rid)
+        http_delete("127.0.0.1", port, f"/v1/requests/{rid}")
+    for t in fillers + [queued_t] + burst:
+        t.join(timeout=30)
+    return probes
+
+
+def churn_soak(args) -> int:
+    """Client-churn soak: no process ever dies — the CLIENTS do. Seeded
+    cancels and disconnects land mid-queue, mid-stream, mid-slot, and
+    mid-preemption against an in-flight, two-tier, journaled server; the
+    audit then proves the server reclaimed everything: zero busy slots,
+    prefix-cache pins back to baseline, every journaled ACCEPT terminal
+    (CANCELLED included), and every COMPLETE byte-identical to the
+    deterministic reference."""
+    journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-churn-")
+    own_dir = args.journal_dir is None
+    server_args = [
+        "--max-batch", "4",
+        "--max-wait-ms", "20",
+        "--drain-timeout-s", "20",
+        "--trace-sample", "0",
+        "--inflight", "--slots", "4",
+        "--tenants", "interactive:4:0,batch:1:0:batch",
+        "--fake-batch-overhead-ms", str(args.fake_batch_overhead_ms),
+        "--fake-per-prompt-ms", str(args.fake_per_prompt_ms),
+        "--fake-segment-overhead-ms", "30",
+        # 2 words/segment -> a 40-word summary spans ~20 segments (~600ms):
+        # abandoned streams are still decoding when the idle window fires
+        "--fake-segment-words", "2",
+        "--stream-heartbeat-s", "0.1",
+        "--stream-idle-timeout-s", str(args.stream_idle_timeout_s),
+    ]
+    server_env = {
+        # widen the eviction->PREEMPTED-journal gap so mid-preemption
+        # cancels have a real window to land in
+        "VNSUM_CHAOS_PREEMPT_GAP_MS": str(args.preempt_gap_ms),
+    }
+    port = free_port()
+    srv = ServerProcess(port, journal_dir=journal_dir,
+                        extra_args=server_args, env=server_env)
+    srv.start()
+    srv.wait_healthy()
+    driver = ChurnDriver(port, args.clients, args.per_client, args.seed)
+    print(f"churn soak: {args.clients} clients x {args.per_client} "
+          f"requests, seed={args.seed}", flush=True)
+    counters: dict = {}
+    try:
+        driver.start()
+        if not driver.join(timeout_s=120):
+            driver.stop()
+            print("FAIL: churn driver never finished")
+            return 1
+        probes = _churn_stage_probes(port)
+
+        # quiesce: every accepted request terminal, nothing resident
+        t_end = time.monotonic() + args.quiesce_timeout_s
+        while time.monotonic() < t_end:
+            pending = scrape_metric(port, "vnsum_serve_journal_pending")
+            busy = scrape_metric(port, "vnsum_serve_slots_busy")
+            depth = scrape_metric(port, "vnsum_serve_queue_depth")
+            if pending == 0 and busy == 0 and depth == 0:
+                break
+            time.sleep(0.2)
+        for name in (
+            "vnsum_serve_journal_pending",
+            "vnsum_serve_slots_busy",
+            "vnsum_serve_queue_depth",
+            "vnsum_serve_cache_pinned_blocks",
+            'vnsum_serve_cancel_requests_total{stage="queued"}',
+            'vnsum_serve_cancel_requests_total{stage="dispatched"}',
+            'vnsum_serve_cancel_requests_total{stage="resident"}',
+            "vnsum_serve_cancel_disconnects_total",
+            "vnsum_serve_qos_preemptions_total",
+            "vnsum_serve_stream_backpressure_coalesced_total",
+            "vnsum_serve_stream_heartbeats_total",
+        ):
+            counters[name] = scrape_metric(port, name)
+
+        srv.sigterm()
+        rc = srv.wait_exit(timeout_s=30)
+        if rc != 0:
+            print(f"FAIL: graceful SIGTERM shutdown exited {rc}, not 0")
+            return 1
+        srv = None
+    finally:
+        driver.stop()
+        if srv is not None and srv.alive:
+            srv.sigkill()
+
+    # -- offline ledger audit (read-only) ---------------------------------
+    entries, sealed, torn = RequestJournal.read_state(journal_dir)
+    lost = [e.rid for e in entries.values() if not e.terminal]
+    completed = [e for e in entries.values() if e.status == "complete"]
+    cancelled = [e for e in entries.values() if e.status == "cancelled"]
+    mismatches = [
+        e.rid for e in completed if e.text != reference_output(e.payload)
+    ]
+    by_rid = {e.rid: e for e in entries.values()}
+    client_vs_ledger = [
+        rid for rid, text in driver.completed.items()
+        if (e := by_rid.get(rid)) is not None
+        and e.status == "complete" and e.text != text
+    ]
+    # every churned rid must be terminal as cancelled OR complete (losing
+    # the completion race is legal; limbo is not)
+    churn_unresolved = [
+        rid for rid in driver.churned
+        if (e := by_rid.get(rid)) is not None
+        and e.status not in ("cancelled", "complete")
+    ]
+    # mid-preemption coverage: at least one cancelled rid whose raw event
+    # stream also carries a PREEMPTED record
+    raw = b"".join(
+        p.read_bytes() for p in sorted(Path(journal_dir).glob("*.jsonl"))
+    )
+    preempted_rids = {
+        m.group(1).decode()
+        for m in re.finditer(
+            rb'"e":"preempted","rid":"([^"]+)"', raw
+        )
+    }
+    preempt_cancel_overlap = sorted(
+        preempted_rids & {e.rid for e in cancelled}
+    )
+
+    record = {
+        "bench": "chaos_soak_client_churn",
+        "seed": args.seed,
+        "clients": args.clients,
+        "per_client": args.per_client,
+        "mode_counts": driver.mode_counts,
+        "stage_probes": probes,
+        "counters": counters,
+        "sealed": sealed,
+        "torn_records_dropped": torn,
+        "journaled_accepts": len(entries),
+        "completed": len(completed),
+        "cancelled": len(cancelled),
+        "typed_failed": sum(
+            1 for e in entries.values() if e.status == "failed"
+        ),
+        "lost": lost,
+        "replay_byte_mismatches": mismatches,
+        "client_vs_ledger_mismatches": client_vs_ledger,
+        "stream_identity_failures": driver.identity_failures,
+        "churned_unresolved": churn_unresolved,
+        "preempt_cancel_overlap": preempt_cancel_overlap,
+        "client_attempted": len(driver.attempted),
+        "client_saw_200": len(driver.completed),
+        "client_churned": len(driver.churned),
+    }
+    print(json.dumps(record, indent=2, ensure_ascii=False))
+    if args.out:
+        atomic_write_json(args.out, record)
+        print(f"wrote {args.out}")
+    if own_dir:
+        shutil.rmtree(journal_dir, ignore_errors=True)
+
+    ok = (
+        not lost
+        and not mismatches
+        and not client_vs_ledger
+        and not driver.identity_failures
+        and not churn_unresolved
+        and sealed
+        and len(entries) > 0
+        and len(cancelled) > 0
+        # reclamation: nothing resident, no pin leaks at quiesce
+        and counters.get("vnsum_serve_slots_busy") == 0
+        and counters.get("vnsum_serve_queue_depth") == 0
+        and counters.get("vnsum_serve_cache_pinned_blocks") == 0
+        # all four lifecycle stages actually exercised
+        and (counters.get(
+            'vnsum_serve_cancel_requests_total{stage="queued"}') or 0) > 0
+        and (counters.get(
+            'vnsum_serve_cancel_requests_total{stage="resident"}') or 0) > 0
+        and (counters.get("vnsum_serve_cancel_disconnects_total") or 0) > 0
+        and (counters.get("vnsum_serve_qos_preemptions_total") or 0) > 0
+        and len(preempt_cancel_overlap) > 0
+    )
+    print("churn ledger invariant:", "OK" if ok else "VIOLATED")
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -209,9 +587,24 @@ def main(argv=None) -> int:
                    help="qos mode: how long the server sleeps between slot "
                         "eviction and the PREEMPTED journal append (the "
                         "window kills must be able to land in)")
+    p.add_argument("--churn", action="store_true",
+                   help="client-churn soak: no process kills — seeded "
+                        "client cancels (DELETE) and stream disconnects "
+                        "land mid-queue, mid-stream, mid-slot, and "
+                        "mid-preemption against an in-flight two-tier "
+                        "server; the audit asserts zero leaked slots, pin "
+                        "counts back to baseline, every ACCEPT terminal "
+                        "(CANCELLED included), and survivor outputs "
+                        "byte-identical")
+    p.add_argument("--stream-idle-timeout-s", type=float, default=0.4,
+                   help="churn mode: the server's bounded resume window "
+                        "(abandoned streams cancel after this)")
     p.add_argument("--out", default=None,
                    help="optional JSON artifact for the run record")
     args = p.parse_args(argv)
+
+    if args.churn:
+        return churn_soak(args)
 
     journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-chaos-")
     own_dir = args.journal_dir is None

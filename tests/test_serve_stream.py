@@ -41,6 +41,168 @@ def test_channel_emits_monotone_suffix_deltas():
     assert "".join(deltas) == "mot hai ba"
 
 
+def test_channel_coalesces_on_full_preserving_identity():
+    """A slow consumer's pending deltas collapse into fewer events when the
+    bounded channel fills — and the concatenation identity survives,
+    because adjacent deltas concatenate in order."""
+    ch = StreamChannel("r2", maxsize=4)
+    final = ""
+    for i in range(64):
+        final += f"tu{i} "
+        ch.push_text(final)
+    assert ch.coalesced > 0
+    deltas = []
+    while not ch.empty():
+        ev = ch.pop(0.01)
+        if ev and ev[0] == "delta":
+            deltas.append(ev[1]["text"])
+    assert len(deltas) < 64  # actually coalesced
+    assert "".join(deltas) == final
+
+
+def test_channel_coalesce_keeps_latest_progress_and_interleaves():
+    ch = StreamChannel("r3", maxsize=4)
+    ch.push_text("a")
+    for n in range(1, 30):
+        ch.push_event("progress", {"llm_requests_done": n})
+    ch.push_text("ab")
+    events = []
+    while not ch.empty():
+        events.append(ch.pop(0.01))
+    kinds = [e[0] for e in events]
+    assert kinds.count("progress") < 29  # progress runs collapsed
+    last_progress = [e for e in events if e[0] == "progress"][-1]
+    assert last_progress[1]["llm_requests_done"] == 29  # latest survives
+    assert "".join(e[1]["text"] for e in events if e[0] == "delta") == "ab"
+
+
+def test_channel_bound_holds_under_alternating_kinds():
+    """Pathological alternation (delta/progress/delta/...) defeats
+    adjacent-run merging; the global collapse must still hold the hard
+    bound (at most one event per kind) AND the concatenation identity."""
+    ch = StreamChannel("r8", maxsize=6)
+    final = ""
+    for i in range(100):
+        final += f"t{i} "
+        ch.push_text(final)
+        ch.push_event("progress", {"llm_requests_done": i})
+    # bounded despite never popping: the whole backlog is a handful of
+    # events, not 200
+    assert len(ch._q) < 6
+    events = []
+    while not ch.empty():
+        events.append(ch.pop(0.01))
+    assert "".join(p["text"] for n, p, _s in events if n == "delta") == final
+    assert max(
+        p["llm_requests_done"] for n, p, _s in events if n == "progress"
+    ) == 99
+
+
+def test_channel_detach_supersedes_stale_consumer():
+    from vnsum_tpu.serve import StreamDetached
+
+    ch = StreamChannel("r4")
+    gen1 = ch.attach()
+    ch.push_text("mot")
+    assert ch.pop(0.01, gen1)[0] == "delta"
+    gen2 = ch.attach()
+    with pytest.raises(StreamDetached):
+        ch.pop(0.01, gen1)  # the stale consumer must stand down
+    ch.push_text("mot hai")
+    assert ch.pop(0.01, gen2)[1]["text"] == " hai"
+
+
+def test_channel_resume_snapshot_folds_buffered_deltas():
+    ch = StreamChannel("r5")
+    ch.push_text("mot")
+    ch.push_text("mot hai")          # both deltas still buffered
+    ch.push_event("progress", {"llm_requests_done": 1})
+    text, seq = ch.resume_snapshot()
+    assert text == "mot hai" and seq >= 2
+    # buffered deltas are gone (their bytes live in the snapshot); the
+    # progress event survived
+    ev = ch.pop(0.01)
+    assert ev[0] == "progress"
+    assert ch.empty()
+    ch.push_text("mot hai ba")
+    assert text + ch.pop(0.01)[1]["text"] == "mot hai ba"
+
+
+def test_channel_concatenation_identity_under_concurrent_churn():
+    """Randomized producer/consumer race over a tiny bounded channel, with
+    preemption-style regressions, mid-stream coalescing, and one resume:
+    snapshot + collected deltas must reassemble the exact final text."""
+    import random
+
+    rng = random.Random(13)
+    words = [f"tu{i}" for i in range(400)]
+    final = " ".join(words)
+    ch = StreamChannel("r6", maxsize=8)
+    collected: list[str] = []
+    stop = threading.Event()
+
+    def consumer():
+        while not stop.is_set() or not ch.empty():
+            ev = ch.pop(0.002)
+            if ev and ev[0] == "delta":
+                collected.append(ev[1]["text"])
+            if rng.random() < 0.05:
+                import time as _t
+                _t.sleep(0.003)  # slow consumer: force coalescing
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    upto = 0
+    while upto < len(words):
+        upto += rng.randint(1, 7)
+        snapshot = " ".join(words[: min(upto, len(words))])
+        ch.push_text(snapshot)
+        if rng.random() < 0.2:
+            # preemption restart: a non-extending snapshot emits nothing
+            ch.push_text(" ".join(words[: max(upto // 2, 1)]))
+    ch.push_text(final)
+    stop.set()
+    t.join(timeout=30)
+    assert "".join(collected) == final
+
+
+def test_channel_resume_snapshot_identity_with_consumer_gap():
+    """Disconnect-shaped sequence: consume a prefix, drop events on the
+    floor (the dead socket), resume via snapshot, drain the rest — the
+    reassembled text is exact."""
+    import random
+
+    rng = random.Random(29)
+    words = [f"w{i}" for i in range(200)]
+    final = " ".join(words)
+    ch = StreamChannel("r7", maxsize=8)
+    got: list[str] = []
+    # phase 1: live consumption of a random prefix of pushes
+    upto = 0
+    while upto < 80:
+        upto += rng.randint(1, 9)
+        ch.push_text(" ".join(words[:upto]))
+        if rng.random() < 0.7:
+            ev = ch.pop(0.001)
+            if ev and ev[0] == "delta":
+                got.append(ev[1]["text"])
+    prefix = "".join(got)
+    # phase 2: disconnected — more pushes pile up (and coalesce)
+    while upto < len(words):
+        upto += rng.randint(1, 9)
+        ch.push_text(" ".join(words[: min(upto, len(words))]))
+    ch.push_text(final)
+    # phase 3: resume — the snapshot replaces everything buffered
+    text, _seq = ch.resume_snapshot()
+    assert text.startswith(prefix)
+    rest: list[str] = []
+    while not ch.empty():
+        ev = ch.pop(0.001)
+        if ev and ev[0] == "delta":
+            rest.append(ev[1]["text"])
+    assert text + "".join(rest) == final
+
+
 # -- SSE over HTTP ------------------------------------------------------------
 
 

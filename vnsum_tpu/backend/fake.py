@@ -123,6 +123,13 @@ class FakeBackend:
         self.cache_hints_seen: list[str | None] = []
         self._spec_report: list[SpecRecord] = []
         self._cache_report: list[int] = []
+        # cooperative cancel flag (serve/scheduler.py::_dispatch): polled at
+        # the simulated segment boundaries of a one-shot dispatch; True
+        # aborts the remaining decode sleep — the hermetic mirror of an
+        # engine checking a cancel flag between decode segments. None = off
+        # (every pre-cancellation caller unchanged)
+        self._cancel_poll = None
+        self.cancel_aborts = 0
 
     def _one(self, prompt: str) -> str:
         if self._responses is not None:
@@ -221,7 +228,7 @@ class FakeBackend:
                 (len(o.split()) for o in outs_early), default=0
             )
         if prefill_s or decode_s:
-            time.sleep(prefill_s + decode_s)
+            self._sleep_cancellable(prefill_s + decode_s)
         # engine-telemetry contract mirror: the latency model's fixed
         # per-dispatch cost (plus the per-uncached-token prefill term) plays
         # the prefill phase and the marginal per-row cost plays decode, so
@@ -253,6 +260,34 @@ class FakeBackend:
             accepted_tokens=int(drafted * self.spec_acceptance),
             verify_steps=steps,
         )
+
+    def set_cancel_poll(self, poll) -> None:
+        """Arm (or clear, with None) the cooperative cancel flag the
+        scheduler sets around a one-shot dispatch — the backend-optional
+        hook checked at segment boundaries, same shape as
+        take_spec_report's duck typing."""
+        self._cancel_poll = poll
+
+    def _sleep_cancellable(self, seconds: float) -> bool:
+        """The dispatch sleep, sliced at segment granularity when a cancel
+        poll is armed: each slice is one simulated decode segment
+        (``segment_words`` steps), and a poll returning True abandons the
+        remainder — the whole batch was cancelled, so burning more
+        simulated device time would only model waste. Returns True when
+        aborted."""
+        if self._cancel_poll is None:
+            time.sleep(seconds)
+            return False
+        seg = max(self.per_step_s * self.segment_words, 0.002)
+        t_end = time.monotonic() + seconds
+        while True:
+            if self._cancel_poll():
+                self.cancel_aborts += 1
+                return True
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                return False
+            time.sleep(min(seg, remaining))
 
     def take_spec_report(self) -> list[SpecRecord]:
         """Per-prompt SpecRecords of the LAST generate call (empty when
@@ -461,23 +496,24 @@ class FakeSlotLoop:
         emit("decode_seg", t0, res.seconds, live=res.live, refill=True)
         return res
 
-    def evict(self, keys):
-        """Preemption double (mirrors TpuSlotLoop.evict): free the slots,
-        drop decode progress, and — with the synthetic radix index on —
-        return each evictee's prompt prefix PINNED so the requeue's
-        admission finds it warm and unevicted."""
+    def evict(self, keys, pin: bool = True):
+        """Preemption/cancellation double (mirrors TpuSlotLoop.evict): free
+        the slots, drop decode progress, and — with the synthetic radix
+        index on and ``pin`` True — return each evictee's prompt prefix
+        PINNED so the requeue's admission finds it warm and unevicted.
+        ``pin=False`` is the cancel path: terminal, nothing to keep warm."""
         b = self.backend
         targets = {id(k) for k in keys}
         out = []
         for s, k in enumerate(self._keys):
             if k is None or id(k) not in targets:
                 continue
-            pin = None
-            if b.prefix_index is not None:
+            ev_pin = None
+            if pin and b.prefix_index is not None:
                 words = self._prompts[s].split()
                 m = b.prefix_index.match(words, max_tokens=len(words) - 1)
-                pin = (b.prefix_index, m)
-            out.append(self._SlotEviction(key=k, slot=s, pin=pin))
+                ev_pin = (b.prefix_index, m)
+            out.append(self._SlotEviction(key=k, slot=s, pin=ev_pin))
             self._keys[s] = None
             self._words[s] = None
             self._prompts[s] = None

@@ -403,15 +403,19 @@ class TpuSlotLoop:
 
     # -- preemption / streaming (serve/qos.py + serve/stream.py) ---------
 
-    def evict(self, keys) -> list[SlotEviction]:
-        """Free the slots of ``keys`` mid-decode (priority-tier
-        preemption): their done flags flip on device so the next segment
-        skips them, their host rows clear, and — when a prefix cache is
-        configured — each evictee's prompt prefix is matched and left
-        PINNED (the returned SlotEviction.pin) so its cached blocks
-        survive LRU until the scheduler releases them. The evictee's
-        decode state is dropped; a requeue restarts it from its prompt
-        (greedy restarts are byte-identical by engine determinism)."""
+    def evict(self, keys, pin: bool = True) -> list[SlotEviction]:
+        """Free the slots of ``keys`` mid-decode (priority-tier preemption
+        and request cancellation): their done flags flip on device so the
+        next segment skips them, their host rows clear, and — when a
+        prefix cache is configured and ``pin`` is True — each evictee's
+        prompt prefix is matched and left PINNED (the returned
+        SlotEviction.pin) so its cached blocks survive LRU until the
+        scheduler releases them. ``pin=False`` is the CANCEL path: the
+        request is terminal, so there is no restart prefill to keep warm —
+        taking a pin would only be refcount churn the scheduler
+        immediately unwinds. The evictee's decode state is dropped either
+        way; a preemption requeue restarts it from its prompt (greedy
+        restarts are byte-identical by engine determinism)."""
         import jax.numpy as jnp
 
         b = self.backend
@@ -424,14 +428,14 @@ class TpuSlotLoop:
             return []
         self._done = self._done.at[jnp.asarray(slots, jnp.int32)].set(True)
         out: list[SlotEviction] = []
-        pc = b.prefix_cache
+        pc = b.prefix_cache if pin else None
         for s in slots:
-            pin = None
+            ev_pin = None
             if pc is not None:
                 ids = b.tok.encode_batch([self._prompts[s]], add_bos=True)[0]
                 m = pc.match(ids, max_tokens=len(ids) - 1)
-                pin = (pc, m)
-            out.append(SlotEviction(key=self._keys[s], slot=s, pin=pin))
+                ev_pin = (pc, m)
+            out.append(SlotEviction(key=self._keys[s], slot=s, pin=ev_pin))
             self._keys[s] = None
             self._prompts[s] = None
             self._admissions.pop(s, None)

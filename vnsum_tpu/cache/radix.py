@@ -106,20 +106,27 @@ class RadixIndex:
         tests pin that a crashed dispatch returns this to its pre-batch
         level), never on the serving path."""
         with self._lock:
-            count = 0
-            stack = [self._root]
-            while stack:
-                node = stack.pop()
-                if node.refs > 0:
-                    count += 1
-                stack.extend(node.children.values())
-            return count
+            return self._pinned_locked()
+
+    def _pinned_locked(self) -> int:
+        count = 0
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if node.refs > 0:
+                count += 1
+            stack.extend(node.children.values())
+        return count
 
     def stats_dict(self) -> dict:
         with self._lock:
             d = self.stats.to_dict()
             d["blocks_used"] = self.num_blocks - len(self._free)
             d["blocks_total"] = self.num_blocks
+            # scrape-time pin-leak probe (vnsum_serve_cache_pinned_blocks):
+            # O(nodes), fine at scrape cadence — the churn chaos soak
+            # asserts this returns to baseline after client churn
+            d["pinned_blocks"] = self._pinned_locked()
             return d
 
     # -- matching --------------------------------------------------------

@@ -182,6 +182,18 @@ class ServingStats:
     stream_requests: int = 0
     stream_events: int = 0
     streams_open: int = 0
+    # request cancellation (serve/scheduler.py cancel paths): terminal
+    # cancels by lifecycle stage (queued / dispatched / resident), plus how
+    # many were triggered by a client disconnect or idle-consumer timeout
+    # rather than an explicit DELETE
+    cancelled: dict[str, int] = field(default_factory=dict)  # stage -> count
+    cancel_disconnects: int = 0
+    # stream hardening (serve/stream.py): pending events collapsed by the
+    # bounded channel's coalesce-on-full, Last-Event-ID reattaches served,
+    # and keepalive heartbeat frames written
+    stream_coalesced: int = 0
+    stream_resumes: int = 0
+    stream_heartbeats: int = 0
 
     @property
     def shed_total(self) -> int:

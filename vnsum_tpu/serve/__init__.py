@@ -38,7 +38,14 @@ This package is the missing online front-end for the batched engine:
                 tier preemptible in in-flight mode
 - stream.py     per-request SSE emit channel: the slot loop's harvest
                 pushes decode-progress deltas at segment boundaries;
-                concatenated deltas are byte-identical to the final text
+                concatenated deltas are byte-identical to the final text.
+                BOUNDED: a slow consumer's pending events coalesce, and
+                the StreamRegistry serves Last-Event-ID resumes off the
+                channel's high-water snapshot. Cancellation rides the
+                schedulers (DELETE /v1/requests/<id> + disconnect sweep):
+                queued requests unwind their QoS bill, residents evict
+                without requeue, and a typed CANCELLED terminal event
+                rides the journal
 - metrics.py    per-request + aggregate observability: counters, rolling
                 gauges, and fixed-bucket histograms (queue wait / TTFT /
                 e2e / occupancy / accepted-per-step) in Prometheus text;
@@ -51,13 +58,19 @@ The engine itself is untouched: ONE scheduler thread owns all
 backend.generate calls (TpuBackend's jit caches and stats are not
 thread-safe), and concurrency lives entirely in front of it.
 """
-from .queue import RequestQueue, RequestShed, ServeRequest, ShedReason
+from .queue import (
+    RequestCancelled,
+    RequestQueue,
+    RequestShed,
+    ServeRequest,
+    ShedReason,
+)
 from .scheduler import MicroBatchScheduler, QueuedBackend
 from .inflight import InflightScheduler
 from .journal import JournalEntry, RequestJournal
 from .metrics import ServeMetrics
 from .qos import TenantSpec, TenantTable, TokenBucket, parse_tenant_specs
-from .stream import StreamChannel
+from .stream import StreamChannel, StreamDetached, StreamRegistry
 from .supervisor import (
     EngineSupervisor,
     FailureClass,
@@ -76,6 +89,7 @@ __all__ = [
     "MicroBatchScheduler",
     "RequestJournal",
     "QueuedBackend",
+    "RequestCancelled",
     "RequestFailed",
     "RequestQueue",
     "RequestShed",
@@ -85,6 +99,8 @@ __all__ = [
     "ServeRequest",
     "ShedReason",
     "StreamChannel",
+    "StreamDetached",
+    "StreamRegistry",
     "TenantSpec",
     "TenantTable",
     "TokenBucket",

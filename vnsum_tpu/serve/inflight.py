@@ -121,6 +121,7 @@ class InflightScheduler(MicroBatchScheduler):
         draining = False  # queue closed: serve what remains, then exit
         while True:
             try:
+                self._cancel_sweep_inflight(loop)
                 if not draining and self.tenants is not None:
                     self._maybe_preempt(loop, loop_key)
                 active = loop.active if loop is not None else 0
@@ -235,6 +236,9 @@ class InflightScheduler(MicroBatchScheduler):
         loop = self._live_loop
         if loop is not None:
             stranded.extend(loop.outstanding())
+        # an oversized-prompt fallback batch mid-_run_batch is in-flight
+        # work too (both for drain-overrun sheds and the cancel surface)
+        stranded.extend(self._dispatching or [])
         return stranded
 
     def _take(self, loop, loop_key, active: int):
@@ -256,6 +260,51 @@ class InflightScheduler(MicroBatchScheduler):
             # so the resident batch drains and the loop is rebuilt for it
             return []
         return self.queue.take_upto(loop.free, key=loop_key)
+
+    def _cancel_sweep_inflight(self, loop) -> None:
+        """Cancellation at the segment boundary — the in-flight half of the
+        cancel contract: queued matches leave through the base sweep,
+        taken-but-unadmitted ones resolve here (their DRR charge is
+        credited back), and cancelled RESIDENTS are evicted through the
+        same slot machinery preemption uses — but WITHOUT requeue and
+        WITHOUT pinning their prefix (``evict(pin=False)``): a cancelled
+        request is terminal, so warming its restart would pin blocks
+        nobody will ever resume. Freed slots refill from the queue at this
+        very boundary, which is what makes cancelling a saturating tenant
+        hand the engine back within one segment."""
+        if not self.cancellation_enabled:
+            return
+        if not self._cancelled_ids and self.stream_idle_timeout_s is None:
+            return  # unlocked fast path, same contract as the base sweep
+        self._cancel_sweep()
+        live: list[ServeRequest] = []
+        for r in self._pending:
+            reason = self._cancel_reason_for(r)
+            if reason is not None:
+                self._resolve_cancelled(r, "queued", reason, taken=True)
+            else:
+                live.append(r)
+        self._pending = live
+        if loop is None or not loop.active:
+            return
+        victims = [
+            (r, reason) for r in loop.outstanding()
+            if (reason := self._cancel_reason_for(r)) is not None
+        ]
+        if not victims:
+            return
+        evictions = loop.evict([r for r, _ in victims], pin=False)
+        reasons = {id(r): why for r, why in victims}
+        for ev in evictions:
+            r: ServeRequest = ev.key
+            self._resolve_cancelled(
+                r, "resident", reasons.get(id(r), "api")
+            )
+        if evictions:
+            logger.info(
+                "cancelled %d resident slot(s) at the segment boundary",
+                len(evictions),
+            )
 
     def _maybe_preempt(self, loop, loop_key) -> None:
         """Priority-tier preemption (serve/qos.py): when interactive work
@@ -369,7 +418,12 @@ class InflightScheduler(MicroBatchScheduler):
         now = time.monotonic()
         live: list[ServeRequest] = []
         for r in pending:
-            if r.expired(now):
+            reason = self._cancel_reason_for(r)
+            if reason is not None:
+                # cancelled between take and slot admission: resolve before
+                # any prefill work, crediting the DRR charge the take made
+                self._resolve_cancelled(r, "queued", reason, taken=True)
+            elif r.expired(now):
                 # the queue sheds expired requests it still holds; taken-but
                 # -unadmitted ones are this scheduler's to shed — including
                 # the owned-trace finalization the queue-side _on_shed hook

@@ -67,6 +67,12 @@ EV_START = "start"
 EV_COMPLETE = "complete"
 EV_FAILED = "failed"
 EV_SEAL = "seal"
+# typed terminal cancellation (serve/scheduler.py cancel paths): the client
+# withdrew the request (DELETE /v1/requests/<id>) or stopped listening
+# (stream disconnect past the resume window). TERMINAL like COMPLETE/FAILED
+# — compaction preserves it and restart replay never resurrects a cancelled
+# request (the ledger invariant counts it as resolved, not lost)
+EV_CANCELLED = "cancelled"
 # QoS lifecycle (serve/qos.py + serve/inflight.py): PREEMPTED marks a
 # batch-tier request evicted from its decode slot, REQUEUED its re-entry
 # into the queue (both non-terminal — the ACCEPT payload stays replayable,
@@ -99,14 +105,14 @@ class JournalEntry:
 
     @property
     def terminal(self) -> bool:
-        return self.status in (EV_COMPLETE, EV_FAILED)
+        return self.status in (EV_COMPLETE, EV_FAILED, EV_CANCELLED)
 
     def to_dict(self) -> dict:
         d = {"rid": self.rid, "status": self.status}
         if self.status == EV_COMPLETE:
             d["text"] = self.text
             d["generated_tokens"] = self.gen_tokens
-        elif self.status == EV_FAILED:
+        elif self.status in (EV_FAILED, EV_CANCELLED):
             d["reason"] = self.reason
             d["detail"] = self.detail
         return d
@@ -256,6 +262,12 @@ class RequestJournal:
                     f.write(_encode({"e": EV_FAILED, "rid": entry.rid,
                                      "reason": entry.reason,
                                      "detail": entry.detail}))
+                elif entry.status == EV_CANCELLED:
+                    # compaction-safe: a cancelled entry must stay CANCELLED
+                    # across reopens — compacting it to a bare ACCEPT would
+                    # resurrect it at the next restart replay
+                    f.write(_encode({"e": EV_CANCELLED, "rid": entry.rid,
+                                     "reason": entry.reason}))
                 elif entry.status in _NONTERMINAL_STATES:
                     # preserve mid-lifecycle state (start / preempted /
                     # requeued / streaming) so the poll surface stays
@@ -410,6 +422,25 @@ class RequestJournal:
             )
             self._evict_terminal_locked()
 
+    def cancel(self, rid: str, reason: str = "api") -> None:
+        """Typed terminal CANCELLED — the client withdrew the request or
+        stopped listening. Terminal like fail(): the ledger invariant
+        counts it resolved, replay skips it, and (like every terminal
+        append) it no-ops on an already-terminal entry, which is what makes
+        DELETE idempotent against completion races."""
+        with self._lock:
+            entry = self._entries.get(rid)
+            if entry is None or entry.terminal:
+                return
+            entry.status = EV_CANCELLED
+            entry.reason = reason
+            self._terminal += 1
+            self._append_locked(
+                {"e": EV_CANCELLED, "rid": rid, "reason": reason},
+                allow_sync=True,
+            )
+            self._evict_terminal_locked()
+
     def sync(self) -> None:
         """Force the batched fsync now."""
         with self._lock:
@@ -494,6 +525,49 @@ class RequestJournal:
         return entries, sealed, torn
 
 
+def aggregate_status(entries: list[JournalEntry]) -> str:
+    """Fold one request's ledger entries (the id plus its ``#N`` fan-out
+    children) into the ONE client-facing status — shared by
+    ``GET /v1/requests/<id>`` and the ``DELETE`` cancel surface so the two
+    can never disagree.
+
+    Entries under one id are either RETRIES of one payload (same prompt —
+    client re-submitted after a crash, at-least-once) or FAN-OUT siblings
+    (different prompts). For retries any COMPLETE means the request
+    succeeded, whatever a replayed duplicate did; for fan-out a failed
+    child fails the request, and a cancelled child (with everyone else
+    already terminal) marks the gang cancelled. Mid-lifecycle precedence
+    (QoS + streaming states): any child actively on the engine
+    (streaming > started) outranks one parked by preemption
+    (requeued > preempted) — the aggregate answers "is anything moving",
+    not "is everything moving"."""
+    statuses = {e.status for e in entries}
+    same_payload = len({e.payload.get("prompt") for e in entries}) == 1
+    if same_payload and EV_COMPLETE in statuses:
+        return "completed"
+    if EV_FAILED in statuses:
+        return "failed"
+    if statuses == {EV_COMPLETE}:
+        return "completed"
+    if (
+        EV_CANCELLED in statuses
+        and statuses <= {EV_CANCELLED, EV_COMPLETE}
+    ):
+        # the gang is fully terminal with at least one cancel: the request
+        # was withdrawn. A still-moving sibling falls through to the
+        # mid-lifecycle states below instead (cancel is in flight)
+        return "cancelled"
+    if EV_STREAM in statuses:
+        return "streaming"
+    if EV_START in statuses or EV_COMPLETE in statuses:
+        return "started"  # partial progress across fan-out
+    if EV_REQUEUE in statuses:
+        return "requeued"  # preempted, back in the queue
+    if EV_PREEMPT in statuses:
+        return "preempted"  # evicted, requeue not yet journaled
+    return "accepted"
+
+
 # -- directory scan ----------------------------------------------------------
 
 
@@ -570,6 +644,11 @@ def _apply(entries: OrderedDict, rec: dict) -> bool:
             entry.status = EV_FAILED
             entry.reason = str(rec.get("reason", "error"))
             entry.detail = str(rec.get("detail", ""))
+    elif ev == EV_CANCELLED:
+        entry = entries.get(rid)
+        if entry is not None and not entry.terminal:
+            entry.status = EV_CANCELLED
+            entry.reason = str(rec.get("reason", "api"))
     return False
 
 

@@ -135,6 +135,21 @@ _reg("stream_events_total", "counter",
      "SSE events written to streaming responses (deltas + progress + done)")
 _reg("stream_active", "gauge",
      "streaming responses open right now")
+_reg("cancel_requests_total", "counter",
+     "requests terminally cancelled, by lifecycle stage at cancel")
+_reg("cancel_disconnects_total", "counter",
+     "cancellations triggered by client disconnect / idle-consumer timeout "
+     "(vs an explicit DELETE)")
+_reg("stream_backpressure_coalesced_total", "counter",
+     "pending stream events collapsed by the bounded channel's "
+     "coalesce-on-full (slow consumer backpressure)")
+_reg("stream_resumes_total", "counter",
+     "streaming reconnects served via Last-Event-ID (snapshot + continue)")
+_reg("stream_heartbeats_total", "counter",
+     "SSE keepalive heartbeat comment frames written")
+_reg("cache_pinned_blocks", "gauge",
+     "prefix-cache blocks pinned by live matches at scrape (leak probe: "
+     "returns to 0 when no batch is in flight)")
 _reg("journal_records_total", "counter",
      "write-ahead journal records appended (accept/start/complete/failed)")
 _reg("journal_appended_bytes_total", "counter",
@@ -296,6 +311,34 @@ class ServeMetrics:
                 self._stats.streams_open + delta, 0
             )
 
+    # -- cancellation / stream-hardening hooks ----------------------------
+
+    def observe_cancel(self, stage: str, n: int = 1) -> None:
+        """One terminal cancellation, keyed by the lifecycle stage it
+        landed in: queued (never dispatched), dispatched (one-shot batch in
+        the engine), resident (evicted from a decode slot)."""
+        with self._lock:
+            c = self._stats.cancelled
+            c[stage] = c.get(stage, 0) + n
+
+    def observe_cancel_disconnect(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.cancel_disconnects += n
+
+    def observe_stream_coalesced(self, n: int = 1) -> None:
+        """Pending events collapsed by a bounded StreamChannel hitting its
+        maxsize — the backpressure signal a wedged consumer emits."""
+        with self._lock:
+            self._stats.stream_coalesced += n
+
+    def observe_stream_resume(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.stream_resumes += n
+
+    def observe_stream_heartbeat(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.stream_heartbeats += n
+
     def observe_degraded(self, down: bool) -> None:
         """One ladder transition: down=True is a step-down (strike
         threshold), False a recovery step-up."""
@@ -429,6 +472,20 @@ class ServeMetrics:
         simple("stream_requests_total", s.stream_requests)
         simple("stream_events_total", s.stream_events)
         simple("stream_active", s.streams_open)
+        typ, help_ = _METRICS["cancel_requests_total"]
+        lines.append(f"# HELP {_PREFIX}cancel_requests_total {help_}")
+        lines.append(f"# TYPE {_PREFIX}cancel_requests_total {typ}")
+        # stable label set: every lifecycle stage renders, zeros included,
+        # so dashboards see series before the first cancel of a stage
+        for stage in ("queued", "dispatched", "resident"):
+            lines.append(
+                f'{_PREFIX}cancel_requests_total{{stage="{stage}"}} '
+                f"{s.cancelled.get(stage, 0)}"
+            )
+        simple("cancel_disconnects_total", s.cancel_disconnects)
+        simple("stream_backpressure_coalesced_total", s.stream_coalesced)
+        simple("stream_resumes_total", s.stream_resumes)
+        simple("stream_heartbeats_total", s.stream_heartbeats)
         if qos_state is not None:
             # per-tenant series, read from the live TenantTable at scrape
             # time like the queue gauges — the metrics layer never mirrors
@@ -492,6 +549,11 @@ class ServeMetrics:
             simple("cache_evictions_total", cache_stats.get("evictions", 0))
             simple("cache_blocks_used", cache_stats.get("blocks_used", 0))
             simple("cache_blocks_total", cache_stats.get("blocks_total", 0))
+            if "pinned_blocks" in cache_stats:
+                # live-match pin count (radix introspection): the chaos
+                # soaks assert this returns to baseline after churn — a
+                # non-zero value with no batch in flight is a pin leak
+                simple("cache_pinned_blocks", cache_stats["pinned_blocks"])
         if queue_depth is not None:
             simple("queue_depth", queue_depth)
         if queued_tokens is not None:

@@ -242,6 +242,26 @@ class TenantTable:
                 return None
             return st.bucket.take(tokens)
 
+    def refund(self, tenant: str, tokens: int, *, deficit: bool = False) -> None:
+        """Unwind QoS accounting for a request cancelled before any engine
+        work (serve/scheduler.py cancellation): the tokens it billed at
+        admission return to its rate bucket (capped at burst — a refund
+        never banks beyond the bucket's ceiling), and with ``deficit=True``
+        (a request cancelled after take but before dispatch) the DRR
+        deficit it drained at the take commit point is credited back, so a
+        cancel storm can't silently tax one tenant's long-run share.
+        Unknown tenants no-op, mirroring :meth:`admit`."""
+        with self._lock:
+            st = self._tenants.get(tenant or DEFAULT_TENANT)
+            if st is None:
+                return
+            if st.bucket is not None and st.bucket.rate > 0:
+                st.bucket.level = min(
+                    st.bucket.burst, st.bucket.level + max(float(tokens), 0.0)
+                )
+            if deficit:
+                st.deficit += max(float(tokens), 0.0)
+
     # -- the deficit-round-robin pick ------------------------------------
 
     def _state_for_locked(self, name: str) -> _TenantState:

@@ -60,6 +60,24 @@ class RequestShed(RuntimeError):
         )
 
 
+class RequestCancelled(RuntimeError):
+    """Typed terminal cancellation: the client asked for it
+    (``DELETE /v1/requests/<id>``) or stopped listening (stream disconnect
+    past the resume window / idle-consumer timeout). Delivered through the
+    request future; the HTTP layer maps it to a 409 and the streaming
+    layer to a typed terminal ``error`` event. ``stage`` names where in
+    the lifecycle the cancel landed (queued / dispatched / resident) and
+    ``reason`` why (api / disconnect)."""
+
+    def __init__(self, stage: str = "", reason: str = "api") -> None:
+        self.stage = stage
+        self.reason = reason
+        super().__init__(
+            f"request cancelled ({reason})"
+            + (f" while {stage}" if stage else "")
+        )
+
+
 _ids = itertools.count()
 
 
@@ -464,6 +482,25 @@ class RequestQueue:
             n = self._shed_pending_locked()
             self._cond.notify_all()
             return n
+
+    def cancel_where(self, pred) -> list[ServeRequest]:
+        """Remove every queued request matching ``pred`` and release its
+        token bill — the queue half of request cancellation. Deliberately
+        resolution-free: the SCHEDULER owns the terminal bookkeeping
+        (journal CANCELLED, metrics, tenant-bucket refund, the future), so
+        this only mutates queue state, symmetric with the take paths.
+        ``pred`` runs under the queue lock — it must be cheap and must not
+        take other serve locks except leaves (the stream idle probe)."""
+        with self._cond:
+            out = [r for r in self._items if pred(r)]
+            if not out:
+                return []
+            gone = set(id(r) for r in out)
+            self._items = [r for r in self._items if id(r) not in gone]
+            for r in out:
+                self._queued_tokens -= r.billable_tokens
+            self._cond.notify_all()
+            return out
 
     def requeue(self, req: ServeRequest) -> None:
         """Re-admit a PREEMPTED request (serve/inflight.py): no admission

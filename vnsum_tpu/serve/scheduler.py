@@ -43,6 +43,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import InvalidStateError
 
 from ..analysis.sanitizers import make_lock
@@ -52,7 +53,13 @@ from ..core.logging import get_logger
 from ..core.results import ServeRequestRecord
 from ..obs import ObsHub, RequestTrace, reset_collector, set_collector
 from .metrics import ServeMetrics
-from .queue import RequestQueue, RequestShed, ServeRequest, ShedReason
+from .queue import (
+    RequestCancelled,
+    RequestQueue,
+    RequestShed,
+    ServeRequest,
+    ShedReason,
+)
 
 logger = get_logger("vnsum.serve")
 
@@ -140,6 +147,26 @@ class MicroBatchScheduler:
             # doubles as the recovery probe so an idle browned-out server
             # still heals
             self.queue.degraded = supervisor.admission_gate
+        # -- request cancellation (DELETE /v1/requests/<id> + disconnects) --
+        # trace ids with a standing cancel request, LRU-capped. Written by
+        # HTTP handler threads (cancel()), read by the scheduler thread at
+        # every lifecycle boundary; keeping ids after their requests resolve
+        # is what makes DELETE idempotent (a re-DELETE of a finished cancel
+        # answers from here) and closes the submit/cancel race for fan-out
+        # siblings that had not reached the queue yet
+        self._cancel_lock = make_lock("serve.cancel")
+        self._cancelled_ids: OrderedDict[str, str] = OrderedDict()  # guarded by: _cancel_lock
+        self.cancel_max_tracked = 4096
+        # idle-consumer cancel window: a streaming request whose consumer
+        # stopped popping for this long (disconnect with no resume) is
+        # cancelled by the sweep. None = disabled (library default; the
+        # HTTP server arms it via --stream-idle-timeout-s)
+        self.stream_idle_timeout_s: float | None = None
+        # bench-only A/B lever (scripts/bench_serving.py cancel phase):
+        # False skips the per-iteration cancel sweeps so the unused-path
+        # overhead is measurable against the same build. Never exposed as
+        # an operator flag — cancellation is part of the serving contract
+        self.cancellation_enabled = True
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name="vnsum-serve-scheduler", daemon=True
@@ -250,6 +277,128 @@ class MicroBatchScheduler:
                 self.metrics.observe_quota_shed(tenant or "default")
             raise
 
+    # -- cancellation -----------------------------------------------------
+
+    def cancel(self, rid: str, *, reason: str = "api",
+               force_mark: bool = False) -> dict:
+        """Gang-cancel every live request whose trace_id is ``rid`` —
+        fan-out children share the parent's trace_id, so one DELETE
+        reclaims the whole gang. Queued requests are removed and resolved
+        HERE (this thread owns no engine state, and the queue removal is
+        atomic under its lock); engine-side residents, taken-but-pending
+        requests, and the in-flight one-shot batch are MARKED and reclaimed
+        by the scheduler thread at the next segment boundary (the engine is
+        single-threaded by contract — only its thread may touch slots).
+
+        Idempotent: a rid already marked (or already terminal) re-answers
+        with zero counts. ``force_mark`` marks even when nothing live
+        matches — the server uses it when the JOURNAL still holds a
+        non-terminal entry for ``rid`` (a handoff window this thread
+        cannot see into), so the mark is guaranteed to be observed.
+        Returns {"cancelled_queued", "cancel_pending", "known"}."""
+        with self._cancel_lock:
+            already = rid in self._cancelled_ids
+        removed = self.queue.cancel_where(lambda r: r.trace_id == rid)
+        # racy read of scheduler-thread state for the COUNT only (stale =
+        # off by one, never a crash); the authoritative reclaim runs on the
+        # scheduler thread at the next segment boundary
+        pending = [] if self.cancellation_enabled is False else [
+            r for r in self._stranded_snapshot() if r.trace_id == rid
+        ]
+        known = bool(removed or pending or already)
+        if known or force_mark:
+            with self._cancel_lock:
+                self._cancelled_ids[rid] = reason
+                self._cancelled_ids.move_to_end(rid)
+                while len(self._cancelled_ids) > self.cancel_max_tracked:
+                    self._cancelled_ids.popitem(last=False)
+        for r in removed:
+            self._resolve_cancelled(r, "queued", reason)
+        return {
+            "cancelled_queued": len(removed),
+            "cancel_pending": len(pending),
+            "known": known,
+        }
+
+    def _cancel_reason_for(self, r: ServeRequest) -> str | None:
+        """The standing cancel reason for ``r`` (gang-marked trace id or an
+        idle streaming consumer), or None. The unlocked emptiness probe is
+        the fast path: with no cancels and no idle window armed this is two
+        attribute reads per call."""
+        if not self.cancellation_enabled:
+            return None
+        # lint-allow[guarded-by]: unlocked EMPTINESS probe only — a stale read delays detection by one boundary; the authoritative lookup below holds the lock
+        if self._cancelled_ids:
+            with self._cancel_lock:
+                reason = self._cancelled_ids.get(r.trace_id)
+            if reason is not None:
+                return reason
+        t = self.stream_idle_timeout_s
+        if (
+            t is not None
+            and r.stream is not None
+            and r.stream.idle_for() > t
+        ):
+            return "disconnect"
+        return None
+
+    def _cancel_sweep(self) -> None:
+        """Scheduler-thread sweep at lifecycle boundaries: pull cancelled
+        (or consumer-abandoned) requests out of the queue and resolve them.
+        Residents/pending are swept by the in-flight subclass; the one-shot
+        batch is checked inside _dispatch."""
+        if not self.cancellation_enabled:
+            return
+        # lint-allow[guarded-by]: unlocked EMPTINESS probe only — the per-iteration fast path; a stale read delays one sweep, the matching reads hold the lock
+        if not self._cancelled_ids and self.stream_idle_timeout_s is None:
+            return  # unlocked fast path: nothing can match
+        removed = self.queue.cancel_where(
+            lambda r: self._cancel_reason_for(r) is not None
+        )
+        for r in removed:
+            self._resolve_cancelled(
+                r, "queued", self._cancel_reason_for(r) or "disconnect"
+            )
+
+    def _resolve_cancelled(self, r: ServeRequest, stage: str,
+                           reason: str = "api", *,
+                           taken: bool = False) -> None:
+        """Terminal cancellation bookkeeping — the one funnel every cancel
+        path ends in: metrics (stage-labeled; disconnect-triggered ones
+        counted separately), QoS unwind for work the engine never ran
+        (token bucket back-fill; DRR deficit too when ``taken`` — the take
+        commit point had charged it), preempt-pin release, the typed
+        CANCELLED ledger record, the owned-trace finalization, the stream
+        close, and the future."""
+        self.metrics.observe_cancel(stage)
+        if reason == "disconnect":
+            self.metrics.observe_cancel_disconnect()
+        if self.tenants is not None and stage == "queued":
+            # never dispatched: the admission bill buys nothing — return it
+            # (queue-resident requests never charged DRR, so deficit credit
+            # only applies to taken-but-undispatched ones)
+            self.tenants.refund(r.tenant, r.billable_tokens, deficit=taken)
+        self._release_preempt_pins(r)
+        self._journal_cancel(r, reason)
+        if r.own_trace and r.trace is not None and self.obs is not None:
+            self.obs.finish_request(r.trace, f"cancelled:{reason}")
+            r.trace = None
+        if r.stream is not None:
+            # deltas already buffered stay poppable until close; a consumer
+            # that is still attached sees the future's typed exception as
+            # its terminal event, one that is gone stops costing memory
+            r.stream.close()
+        if not r.future.done():
+            try:
+                r.future.set_exception(RequestCancelled(stage, reason))
+            # lint-allow[swallowed-exception]: losing the done()-check race means the scheduler thread resolved this future first — it is already answered, and the cancel sweep must keep going for the rest
+            except InvalidStateError:
+                pass
+
+    def _journal_cancel(self, r: ServeRequest, reason: str) -> None:
+        if self.journal is not None and r.journal_rid is not None:
+            self.journal.cancel(r.journal_rid, reason)
+
     def submit_many(self, prompts, references=None, cache_hints=None, **kw):
         """Admit a round of prompts atomically-ish: if any prompt is shed at
         admission, already-admitted siblings are left to complete (they
@@ -355,6 +504,7 @@ class MicroBatchScheduler:
     def _loop(self) -> None:
         while True:
             try:
+                self._cancel_sweep()
                 batch = self.queue.take_batch(self._take_limit(),
                                               self.max_wait_s)
             # lint-allow[swallowed-exception]: a queue bug must not kill the scheduler thread; no request was taken, so there is no future to resolve
@@ -397,6 +547,18 @@ class MicroBatchScheduler:
         """One engine dispatch: resolves every future on success; on failure
         records the attempt's batch metrics/trace, stashes (t0, engine_s,
         bt) in ``_attempt_ctx`` for the resolvers, and raises."""
+        # cancelled riders leave BEFORE engine work: they were taken off the
+        # queue (DRR charged), so the queued-stage resolution credits it back
+        live = []
+        for r in batch:
+            reason = self._cancel_reason_for(r)
+            if reason is not None:
+                self._resolve_cancelled(r, "queued", reason, taken=True)
+            else:
+                live.append(r)
+        batch[:] = live
+        if not batch:
+            return
         head = batch[0]
         self._attempt_ctx = (time.monotonic(), 0.0, None)
         if self.journal is not None:
@@ -425,6 +587,18 @@ class MicroBatchScheduler:
             # takes the plain decode path (greedy outputs are identical)
             references = [None] * len(batch)
         token = set_collector(bt) if bt is not None else None
+        # cooperative cancel flag for the blocking one-shot program:
+        # backends that expose set_cancel_poll check it at their segment
+        # boundaries and stop burning device time once EVERY rider is
+        # cancelled (a partial cancel can't shrink a fixed batch mid-
+        # flight; the riders resolve typed after the dispatch returns).
+        # The poll runs on THIS thread inside generate — _cancelled ids are
+        # read under their own lock, no engine state is touched
+        set_poll = getattr(self.backend, "set_cancel_poll", None)
+        if callable(set_poll) and self.cancellation_enabled:
+            set_poll(lambda: all(
+                self._cancel_reason_for(r) is not None for r in batch
+            ))
         t0 = time.monotonic()
         try:
             with profile_cm:
@@ -445,6 +619,8 @@ class MicroBatchScheduler:
         finally:
             if token is not None:
                 reset_collector(token)
+            if callable(set_poll) and self.cancellation_enabled:
+                set_poll(None)
         engine_s = time.monotonic() - t0
         if len(outs) != len(batch):
             # a zip would silently drop the tail and strand its futures
@@ -477,6 +653,14 @@ class MicroBatchScheduler:
         for r, out, n_out, spec, cached in zip(
             batch, outs, gen_tokens, spec_report, cache_report
         ):
+            reason = self._cancel_reason_for(r)
+            if reason is not None:
+                # cancelled while the batch was in the engine: the decode
+                # work is sunk, but the outcome is typed CANCELLED — never
+                # COMPLETE (the DELETE contract: a cancelled id must not
+                # resurrect at replay or answer the poll surface as done)
+                self._resolve_cancelled(r, "dispatched", reason)
+                continue
             rec = self._record(r, "ok", t0, engine_s, len(batch), n_out, bt)
             if spec is not None:
                 rec.draft_tokens = spec.draft_tokens

@@ -33,6 +33,17 @@ Endpoints:
     GET /v1/requests/<id>  durable-serving poll surface (--journal-dir):
                         status + result of a journaled request — the
                         reconnect path after a server crash mid-request
+    DELETE /v1/requests/<id>  first-class cancellation: idempotent,
+                        gang-cancels <id>#N fan-out children; queued
+                        requests resolve immediately, slot residents are
+                        evicted (without requeue) at the next segment
+                        boundary, and a typed CANCELLED terminal event
+                        rides the journal so replay never resurrects a
+                        cancelled request. Streaming requests also cancel
+                        automatically on client disconnect once the
+                        bounded resume window (--stream-idle-timeout-s)
+                        expires; within it, a reconnect with Last-Event-ID
+                        resumes via one full-text snapshot event
     GET /metrics        Prometheus text (serve/metrics.py): counters plus
                         queue-wait/TTFT/e2e/occupancy/spec histograms
     GET /debug/trace    Chrome trace-event JSON of the recent-request ring
@@ -71,7 +82,7 @@ from ..obs import ObsHub
 from ..obs.export import save_timestamped_trace
 from ..strategies import get_strategy
 from ..text import clean_thinking_tokens
-from .queue import RequestShed, ShedReason
+from .queue import RequestCancelled, RequestShed, ShedReason
 from .scheduler import MicroBatchScheduler
 from .supervisor import RequestFailed
 
@@ -104,8 +115,21 @@ class ServeState:
         journal_fsync_s: float = 0.05,
         mesh=None,
         tenants=None,
+        stream_heartbeat_s: float = 15.0,
+        stream_idle_timeout_s: float = 10.0,
     ) -> None:
         self.backend = backend
+        # stream hardening (serve/stream.py): SSE keepalive cadence (0 =
+        # no heartbeats) and the bounded resume window — a streaming
+        # request whose consumer disconnected and never reattached within
+        # the idle window is CANCELLED by the scheduler sweep; 0 cancels
+        # immediately on disconnect (no resume window at all)
+        self.stream_heartbeat_s = max(float(stream_heartbeat_s), 0.0)
+        self.stream_idle_timeout_s = max(float(stream_idle_timeout_s), 0.0)
+        # live streams by request id — the Last-Event-ID reconnect surface
+        from .stream import StreamRegistry
+
+        self.streams = StreamRegistry()
         # multi-tenant QoS (serve/qos.py): a TenantTable arms per-tenant
         # weighted-fair scheduling + token-rate quotas in the queue and
         # the X-Tenant header on the HTTP surface; batch-tier tenants'
@@ -178,6 +202,10 @@ class ServeState:
             )
         else:
             self.scheduler = MicroBatchScheduler(backend, **common)
+        if self.stream_idle_timeout_s > 0:
+            # arm the scheduler's idle-consumer sweep: abandoned streams
+            # (disconnect, no resume) cancel after this window
+            self.scheduler.stream_idle_timeout_s = self.stream_idle_timeout_s
         self.default_deadline_s = default_deadline_s
         self._strategies: dict[str, object] = {}
         import threading
@@ -287,6 +315,46 @@ class ServeState:
         if n:
             logger.info("journal replay: re-enqueued %d request(s)", n)
         return n
+
+    def cancel_request(self, rid: str) -> dict | None:
+        """``DELETE /v1/requests/<id>`` — gang-cancel ``rid`` and its
+        ``rid#N`` fan-out children everywhere in the lifecycle. Returns the
+        response payload, or None for a wholly unknown id (typed 404
+        upstream). Idempotent: re-DELETEs answer with zero counts and the
+        ledger's terminal status. With the journal on, a non-terminal
+        ledger entry forces the scheduler mark even when no live request is
+        visible (handoff windows), and entries the scheduler can no longer
+        see (queued in a previous process life, not yet replayed — replay
+        runs before traffic, so only a race can leave one) are closed
+        directly so restart replay can never resurrect them."""
+        entries = self.journal.lookup(rid) if self.journal is not None else []
+        nonterminal = [e for e in entries if not e.terminal]
+        res = self.scheduler.cancel(rid, force_mark=bool(nonterminal))
+        if not res["known"] and not entries:
+            return None
+        if self.journal is not None and nonterminal and not res["cancel_pending"]:
+            # belt and braces for ledger entries with no live request: the
+            # scheduler mark covers every handoff, this closes the record
+            # (idempotent — the journal no-ops on terminal entries, and a
+            # live request resolving later no-ops against this)
+            for e in nonterminal:
+                self.journal.cancel(e.rid, "api")
+        payload: dict = {
+            "request_id": rid,
+            "cancelled_queued": res["cancelled_queued"],
+            "cancel_pending": res["cancel_pending"],
+        }
+        if self.journal is not None:
+            from .journal import aggregate_status
+
+            entries = self.journal.lookup(rid)
+            if entries:
+                payload["status"] = aggregate_status(entries)
+        if "status" not in payload:
+            payload["status"] = (
+                "cancelling" if res["cancel_pending"] else "cancelled"
+            )
+        return payload
 
     def close(self, drain_timeout_s: float = 30.0) -> None:
         self.scheduler.close(drain=True, timeout=drain_timeout_s)
@@ -526,42 +594,20 @@ def make_handler(state: ServeState):
                 return
             entries = state.journal.lookup(rid)
             if not entries:
+                # typed 404, never a 500 — unknown/expired ids are a
+                # client-visible state, not a server fault
                 self._json(
                     {"error": f"unknown or expired request id {rid!r}"}, 404
                 )
                 return
-            statuses = {e.status for e in entries}
-            # entries under one id are either RETRIES of one payload (same
-            # prompt — client re-submitted after a crash, at-least-once) or
-            # FAN-OUT siblings (different prompts). For retries any
-            # COMPLETE means the request succeeded, whatever a replayed
-            # duplicate did; for fan-out a failed child fails the request.
-            # Mid-lifecycle precedence (QoS + streaming states): any child
-            # actively on the engine (streaming > started) outranks one
-            # parked by preemption (requeued > preempted) — the aggregate
-            # answers "is anything moving", not "is everything moving".
-            same_payload = len({
-                e.payload.get("prompt") for e in entries
-            }) == 1
-            if same_payload and "complete" in statuses:
-                status = "completed"
-            elif "failed" in statuses:
-                status = "failed"
-            elif statuses == {"complete"}:
-                status = "completed"
-            elif "streaming" in statuses:
-                status = "streaming"
-            elif "start" in statuses or "complete" in statuses:
-                status = "started"  # partial progress across fan-out
-            elif "requeued" in statuses:
-                status = "requeued"  # preempted, back in the queue
-            elif "preempted" in statuses:
-                status = "preempted"  # evicted, requeue not yet journaled
-            else:
-                status = "accepted"
+            # retry/fan-out aggregation (incl. the cancelled state) is the
+            # ONE shared fold in serve/journal.py — the DELETE surface uses
+            # the same one, so the two can never disagree
+            from .journal import aggregate_status
+
             self._json({
                 "request_id": rid,
-                "status": status,
+                "status": aggregate_status(entries),
                 "entries": [e.to_dict() for e in entries],
             })
 
@@ -661,37 +707,82 @@ def make_handler(state: ServeState):
             self.send_header("Connection", "close")
             self.end_headers()
 
-        def _sse_event(self, name: str, payload: dict) -> None:
+        def _sse_event(self, name: str, payload: dict,
+                       seq: int | None = None) -> None:
             data = json.dumps(payload, ensure_ascii=False)
-            self.wfile.write(f"event: {name}\ndata: {data}\n\n".encode())
+            frame = f"event: {name}\ndata: {data}\n\n"
+            if seq is not None:
+                # SSE event id: the channel's monotone seq — what a
+                # reconnecting client sends back as Last-Event-ID
+                frame = f"id: {seq}\n" + frame
+            self.wfile.write(frame.encode())
             self.wfile.flush()
             state.scheduler.metrics.observe_stream_events()
 
-        def _stream_response(self, channel, done, finish) -> None:
-            """Drain ``channel`` into SSE frames until ``done()`` turns
-            true and the channel is empty, then write the terminal event
-            from ``finish()`` -> (event_name, payload). The terminal
+        def _stream_response(self, channel, done, finish,
+                             gen: int | None = None) -> str:
+            """Open the SSE response and drain ``channel`` until ``done()``
+            turns true and the channel is empty, then write the terminal
+            event from ``finish()`` -> (event_name, payload). The terminal
             payload of a successful request is THE SAME payload the
-            non-streaming path returns. A disconnecting client stops the
-            writes but never the request — the engine side owns its own
+            non-streaming path returns. Returns the drain outcome
+            ("finished" / "disconnected" / "detached" — see
+            _drain_stream); the CALLER decides what cancellation a
+            disconnect implies; the engine side always owns its own
             lifecycle."""
-            metrics = state.scheduler.metrics
-            metrics.observe_stream_open(+1)
             try:
                 self._sse_begin()
+            # lint-allow[swallowed-exception]: returning the outcome IS the answer — a client gone before the headers flushed takes the same disconnect policy as one gone mid-stream
+            except OSError:
+                logger.info("streaming client disconnected before headers "
+                            "(%s)", self._rid)
+                return "disconnected"
+            return self._drain_stream(channel, done, finish, gen)
+
+        def _drain_stream(self, channel, done, finish,
+                          gen: int | None = None) -> str:
+            """The one SSE drain loop (first connection and Last-Event-ID
+            resume both end here; headers are already on the wire).
+            Returns "finished" (terminal event reached the socket),
+            "disconnected" (client gone — the caller runs the disconnect
+            policy), or "detached" (a Last-Event-ID reconnect superseded
+            this consumer — the NEW handler owns the stream, so the caller
+            must neither cancel nor unregister). Quiet stretches emit
+            ``: heartbeat`` comment frames every ``--stream-heartbeat-s``:
+            idle proxies keep the connection, and the write doubles as the
+            disconnect probe for requests that are between segments (a
+            dead socket fails the write -> OSError -> the caller's
+            disconnect policy)."""
+            from .stream import StreamDetached
+
+            metrics = state.scheduler.metrics
+            metrics.observe_stream_open(+1)
+            hb = state.stream_heartbeat_s
+            try:
+                last_write = time.monotonic()
                 while True:
-                    ev = channel.pop(0.05)
+                    try:
+                        ev = channel.pop(0.05, gen)
+                    # lint-allow[swallowed-exception]: detachment IS the resolution — a reconnecting consumer owns the stream now; this stale handler must exit without writing a terminal frame
+                    except StreamDetached:
+                        return "detached"
                     if ev is not None:
-                        self._sse_event(ev[0], ev[1])
+                        self._sse_event(ev[0], ev[1], ev[2])
+                        last_write = time.monotonic()
                         continue
                     if done() and channel.empty():
                         break
+                    if hb and time.monotonic() - last_write >= hb:
+                        self.wfile.write(b": heartbeat\n\n")
+                        self.wfile.flush()
+                        metrics.observe_stream_heartbeat()
+                        last_write = time.monotonic()
                 self._sse_event(*finish())
-            # lint-allow[swallowed-exception]: a mid-stream client disconnect strands no one — the engine side resolves the request future and journals the outcome regardless; there is just no socket left to tell
+                return "finished"
+            # lint-allow[swallowed-exception]: returning the outcome IS the answer — the caller runs the disconnect policy (cancel now or leave the bounded resume window open); the engine side resolves and journals regardless
             except OSError:
-                # client went away mid-stream: the request completes (and
-                # journals) regardless; there is just no one to tell
                 logger.info("streaming client disconnected (%s)", self._rid)
+                return "disconnected"
             finally:
                 metrics.observe_stream_open(-1)
 
@@ -706,6 +797,11 @@ def make_handler(state: ServeState):
                     "error": "shed", "reason": e.reason.value,
                     "retry_after_s": e.retry_after_s or 1.0,
                 }
+            if isinstance(e, RequestCancelled):
+                # the typed terminal for a withdrawn request — what a
+                # Last-Event-ID reconnect after the resume window reads
+                return "error", {"error": "cancelled", "stage": e.stage,
+                                 "reason": e.reason}
             if isinstance(e, RequestFailed):
                 return "error", {"error": "request_failed",
                                  "class": e.failure_class.value,
@@ -735,6 +831,30 @@ def make_handler(state: ServeState):
                 self._summarize()
             else:
                 self._json({"error": "not found"}, 404)
+
+        def do_DELETE(self) -> None:  # noqa: N802 (stdlib API)
+            """``DELETE /v1/requests/<id>`` — first-class cancellation:
+            idempotent, gang-cancels ``<id>#N`` fan-out children, answers
+            with the request's aggregated status plus how many queued
+            requests resolved immediately and how many engine-side ones
+            will be reclaimed at the next segment boundary. Unknown ids are
+            a typed 404."""
+            self._rid = None
+            path = self.path.partition("?")[0]
+            if not path.startswith("/v1/requests/"):
+                self._json({"error": "not found"}, 404)
+                return
+            import urllib.parse
+
+            rid = urllib.parse.unquote(path[len("/v1/requests/"):])
+            self._rid = rid
+            payload = state.cancel_request(rid)
+            if payload is None:
+                self._json(
+                    {"error": f"unknown request id {rid!r}"}, 404
+                )
+                return
+            self._json(payload)
 
         def _generate(self) -> None:
             req = self._read_json()
@@ -833,6 +953,14 @@ def make_handler(state: ServeState):
                     state.obs.finish_request(trace, f"shed:{e.reason.value}")
                 self._shed_response(e)
                 return
+            except RequestCancelled as e:
+                # someone DELETEd this id (or its stream was abandoned)
+                # while this waiter blocked: typed 409, never a 500
+                if state.obs is not None:
+                    state.obs.finish_request(trace, f"cancelled:{e.reason}")
+                self._json({"error": "cancelled", "stage": e.stage,
+                            "reason": e.reason}, 409)
+                return
             except RequestFailed as e:
                 # supervision gave up: typed terminal failure (poison
                 # quarantine, exhausted retries, fatal engine error)
@@ -868,14 +996,28 @@ def make_handler(state: ServeState):
             one-shot path emits one final delta). Concatenated deltas are
             byte-identical to the done event's text — the stream.py delta
             discipline. Admission sheds happen BEFORE the stream opens and
-            answer as plain typed 429s."""
+            answer as plain typed 429s.
+
+            Disconnect policy: the stream is registered for Last-Event-ID
+            resume, so a dropped connection leaves the request running for
+            the BOUNDED idle window (--stream-idle-timeout-s) — reattach in
+            time and the stream continues from a snapshot; don't, and the
+            scheduler's sweep cancels it (automatic cancel-on-disconnect).
+            A zero window cancels right here, before this handler returns."""
             from .stream import StreamChannel
 
+            if self.headers.get("Last-Event-ID") is not None:
+                # reconnect: attach to the live stream instead of
+                # submitting a duplicate request
+                self._resume_stream()
+                return
             trace = (
                 state.obs.start_request(self._rid)
                 if state.obs is not None else None
             )
-            channel = StreamChannel(self._rid)
+            channel = StreamChannel(
+                self._rid, metrics=state.scheduler.metrics
+            )
             try:
                 fut = state.scheduler.submit(
                     prompt,
@@ -898,12 +1040,72 @@ def make_handler(state: ServeState):
                     state.obs.finish_request(trace, f"shed:{e.reason.value}")
                 self._shed_response(e)
                 return
-            self._stream_response(
-                channel, fut.done, lambda: self._stream_finish_generate(fut)
+            if state.obs is not None and trace is not None:
+                # finalize the trace when the REQUEST resolves, not when
+                # this handler exits: a disconnected stream keeps decoding
+                # through the resume window, and its spans must still land
+                # in /debug/trace whether it completes, errors, or is
+                # cancelled by the sweep (the callback fires exactly once,
+                # on whichever thread resolves the future)
+                def _finalize_trace(f, _trace=trace):
+                    e = f.exception()
+                    if isinstance(e, RequestCancelled):
+                        status = f"cancelled:{e.reason}"
+                    else:
+                        status = "ok" if e is None else "error"
+                    state.obs.finish_request(_trace, status)
+
+                fut.add_done_callback(_finalize_trace)
+            state.streams.register(self._rid, channel, fut)
+            gen = channel.attach()
+            outcome = self._stream_response(
+                channel, fut.done,
+                lambda: self._stream_finish_generate(fut), gen=gen,
             )
-            if state.obs is not None:
-                status = "ok" if not fut.exception() else "error"
-                state.obs.finish_request(trace, status)
+            if outcome == "finished":
+                state.streams.unregister(self._rid)
+            elif outcome == "disconnected" and state.stream_idle_timeout_s == 0:
+                # no resume window configured: a disconnect IS the cancel
+                state.scheduler.cancel(self._rid, reason="disconnect")
+                state.streams.unregister(self._rid)
+            # else: disconnected within the idle window (stay registered —
+            # the request keeps decoding; a reconnect resumes it, the sweep
+            # cancels it) or detached (the resumed handler owns the stream
+            # now — cancelling here would kill the live reconnect)
+
+        def _resume_stream(self) -> None:
+            """``Last-Event-ID`` reconnect: reattach to the registered
+            channel (superseding any stale handler), replay ONE full-text
+            ``snapshot`` event off the producer's high-water mark —
+            buffered deltas are folded in, so snapshot + subsequent deltas
+            still reassemble the exact final text — then continue live.
+            Unknown/expired ids answer a typed 404; a request that already
+            finished (or was cancelled past the idle window) replays its
+            snapshot and goes straight to the terminal event."""
+            entry = state.streams.get(self._rid)
+            if entry is None:
+                self._json(
+                    {"error": "no resumable stream for request id "
+                              f"{self._rid!r}"}, 404,
+                )
+                return
+            channel, fut = entry
+            gen = channel.attach()
+            state.scheduler.metrics.observe_stream_resume()
+            text, seq = channel.resume_snapshot()
+            try:
+                self._sse_begin()
+                self._sse_event("snapshot", {"text": text}, seq)
+            # lint-allow[swallowed-exception]: the resuming client vanished before its snapshot landed — the stream stays registered and the idle window keeps running; nothing to resolve here
+            except OSError:
+                logger.info("resume client disconnected (%s)", self._rid)
+                return
+            outcome = self._drain_stream(
+                channel, fut.done,
+                lambda: self._stream_finish_generate(fut), gen,
+            )
+            if outcome == "finished":
+                state.streams.unregister(self._rid)
 
         def _summarize(self) -> None:
             req = self._read_json()
@@ -997,6 +1199,12 @@ def make_handler(state: ServeState):
                     state.obs.finish_request(trace, f"shed:{e.reason.value}")
                 self._shed_response(e)
                 return
+            except RequestCancelled as e:
+                if state.obs is not None:
+                    state.obs.finish_request(trace, f"cancelled:{e.reason}")
+                self._json({"error": "cancelled", "stage": e.stage,
+                            "reason": e.reason}, 409)
+                return
             except RequestFailed as e:
                 if state.obs is not None:
                     state.obs.finish_request(trace, "error")
@@ -1026,7 +1234,7 @@ def make_handler(state: ServeState):
 
             from .stream import StreamChannel
 
-            channel = StreamChannel(self._rid)
+            channel = StreamChannel(self._rid, metrics=state.scheduler.metrics)
             metrics = state.scheduler.metrics
             metrics.observe_stream_request()
 
@@ -1060,18 +1268,35 @@ def make_handler(state: ServeState):
                 logger.error("streamed summarize failed: %s", e)
                 return self._stream_error_event(e)
 
-            self._stream_response(
+            outcome = self._stream_response(
                 channel, lambda: not worker.is_alive(), finish
             )
+            if outcome != "finished":
+                # (no gen is passed for summarize streams, so the only
+                # non-finished outcome here is a real disconnect)
+                # client gone mid-summarize: reclaim instead of logging and
+                # decoding to completion — gang-cancel the fan-out (every
+                # child shares this trace_id, so queued siblings resolve
+                # now and engine residents at the next boundary), stop the
+                # progress pushes, and drop the channel's buffer. The
+                # worker unblocks with RequestCancelled out of its next
+                # round and the strategy run ends
+                state.scheduler.cancel(self._rid, reason="disconnect")
+                qbackend.progress = None
+                channel.close()
             # a client disconnect skips finish() (nobody to write to), but
             # the strategy run still owns the trace: wait it out before
             # finalizing, so spans never land on a finished trace and the
             # recorded status reflects the run's real outcome
             worker.join()
             if state.obs is not None:
-                state.obs.finish_request(
-                    trace, "error" if box.get("error") is not None else "ok"
-                )
+                status = "ok"
+                e = box.get("error")
+                if isinstance(e, RequestCancelled):
+                    status = "cancelled:disconnect"
+                elif e is not None:
+                    status = "error"
+                state.obs.finish_request(trace, status)
 
         def log_message(self, fmt, *args):  # route through our logger
             logger.info("%s %s", self.address_string(), fmt % args)
@@ -1188,6 +1413,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--preempt-budget", type=int, default=16,
                    help="max lifetime preemptions per batch-tier request "
                         "before it becomes non-evictable (starvation bound)")
+    p.add_argument("--stream-heartbeat-s", type=float, default=15.0,
+                   help="SSE keepalive: emit ': heartbeat' comment frames "
+                        "after this much quiet so idle proxies keep the "
+                        "connection; the write doubles as the disconnect "
+                        "probe between segments (0 = off)")
+    p.add_argument("--stream-idle-timeout-s", type=float, default=10.0,
+                   help="bounded resume window: a streaming request whose "
+                        "client disconnected (no pops, no Last-Event-ID "
+                        "reattach) for this long is CANCELLED and its slot "
+                        "reclaimed (0 = cancel immediately on disconnect, "
+                        "no resume window)")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="graceful-shutdown drain budget before queued and "
                         "in-flight requests are shed typed")
@@ -1204,6 +1440,11 @@ def main(argv: list[str] | None = None) -> int:
                         "real time so kills and preemptions land mid-decode)")
     p.add_argument("--fake-per-step-ms", type=float, default=0.0,
                    help="fake backend: per-decode-step latency (both paths)")
+    p.add_argument("--fake-segment-words", type=int, default=8,
+                   help="fake backend: words a slot-loop segment retires "
+                        "per row (smaller = more segment boundaries — the "
+                        "churn soak needs decodes that span many segments "
+                        "so disconnect cancels land mid-decode)")
     args = p.parse_args(argv)
 
     cache_blocks = 0 if args.no_prefix_cache else args.cache_blocks
@@ -1249,6 +1490,7 @@ def main(argv: list[str] | None = None) -> int:
             per_prompt_s=args.fake_per_prompt_ms / 1000.0,
             segment_overhead_s=args.fake_segment_overhead_ms / 1000.0,
             per_step_s=args.fake_per_step_ms / 1000.0,
+            segment_words=args.fake_segment_words,
         )
 
     tenants = None
@@ -1292,6 +1534,8 @@ def main(argv: list[str] | None = None) -> int:
         journal_fsync_s=args.journal_fsync_ms / 1000.0,
         mesh=mesh,
         tenants=tenants,
+        stream_heartbeat_s=args.stream_heartbeat_s,
+        stream_idle_timeout_s=args.stream_idle_timeout_s,
     )
     if args.inflight:
         state.scheduler.preempt_budget = max(args.preempt_budget, 1)

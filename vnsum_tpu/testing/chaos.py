@@ -66,6 +66,78 @@ def http_json(method: str, host: str, port: int, path: str,
         conn.close()
 
 
+def http_delete(host: str, port: int, path: str, timeout: float = 30.0):
+    """One DELETE round trip -> (status, parsed JSON body | None) — the
+    churn soak's cancel verb."""
+    return http_json("DELETE", host, port, path, timeout=timeout)
+
+
+def parse_sse(raw: str) -> list[tuple[str | None, dict | None]]:
+    """Raw SSE body -> [(event_name, payload)] (comment-only frames like
+    the ``: heartbeat`` keepalive parse as (None, None))."""
+    events = []
+    for frame in raw.split("\n\n"):
+        if not frame.strip():
+            continue
+        name = data = None
+        for line in frame.splitlines():
+            if line.startswith("event: "):
+                name = line[len("event: "):]
+            elif line.startswith("data: "):
+                try:
+                    data = json.loads(line[len("data: "):])
+                # lint-allow[swallowed-exception]: a torn frame (the abandon path cuts mid-byte) parses as data=None, which the caller treats as a non-event
+                except ValueError:
+                    data = None
+        events.append((name, data))
+    return events
+
+
+def sse_stream(host: str, port: int, path: str, payload: dict,
+               abandon_after: int | None = None,
+               headers: dict | None = None,
+               timeout: float = 60.0):
+    """Drive one SSE request -> (status, events). ``abandon_after=N`` reads
+    about N frames and then DROPS the connection without finishing — the
+    disconnecting client the churn soak simulates; None reads to the end.
+    Non-200 responses return (status, parsed-JSON-or-None) like http_json."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    resp = None
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raw = resp.read()
+            try:
+                return resp.status, json.loads(raw) if raw else None
+            # lint-allow[swallowed-exception]: a non-JSON error body becomes None — the soak only branches on status
+            except ValueError:
+                return resp.status, None
+        if abandon_after is None:
+            return 200, parse_sse(resp.read().decode(errors="replace"))
+        frames = 0
+        buf = b""
+        while frames < abandon_after:
+            chunk = resp.fp.read1(4096)
+            if not chunk:
+                break
+            buf += chunk
+            frames = buf.count(b"\n\n")
+        return 200, parse_sse(buf.decode(errors="replace"))
+    finally:
+        # http.client hands the socket to the response for
+        # Connection: close replies — closing both covers either owner
+        if resp is not None:
+            try:
+                resp.close()
+            # lint-allow[swallowed-exception]: teardown of an already-dead socket (the abandon path's whole point) has nothing left to resolve
+            except Exception:
+                pass
+        conn.close()
+
+
 class ServerProcess:
     """One serve-server subprocess under chaos control."""
 
