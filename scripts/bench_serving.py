@@ -1006,6 +1006,116 @@ def cancel_phase(args) -> dict:
     }
 
 
+def slo_phase(args) -> dict:
+    """Full-observability overhead A/B (ISSUE 14 tentpole): the r04 mixed
+    in-flight closed loop with the ENTIRE production obs stack armed —
+    request tracing, rolling windows + per-tenant usage ledger, flight
+    recorder, and a four-objective SLO engine — against a build with all
+    of it constructed away (trace_sample=0, windowed_metrics=False,
+    flight_recorder=False, no --slo). The goodput delta IS the layer's
+    cost; <2% is the acceptance bar, same as the journal's. Best-of-3 per
+    arm with the reps INTERLEAVED (on, off, on, off, ...): the in-flight
+    shape at ~100 rps jitters +/-2% run to run on this host — the same
+    order as the bar — and host drift across a multi-minute bench
+    (thermal, CFS) is monotone enough that back-to-back blocks of one arm
+    bias the sign; alternating arms makes both sample the same drift.
+
+    The armed arm also CERTIFIES the surfaces under load: /debug/slo must
+    evaluate all four objectives, /v1/usage must carry the load's tokens,
+    and the flight-recorder ring must hold the lifecycle — an A/B whose
+    "on" arm silently measured a dormant layer would prove nothing."""
+    short = "tin ngan gon sau day chi tam tu"
+    long_ = "phan tich chuyen sau ve tinh hinh kinh te xa hoi " * 6
+
+    def payload(cid, i):
+        return {"prompt": short if (cid + i) % 2 else long_,
+                "deadline_ms": args.deadline_s * 1000}
+
+    backend_kw = dict(
+        batch_overhead_s=args.inflight_prefill_s,
+        per_step_s=args.per_step_s,
+        segment_words=args.segment_words,
+        segment_overhead_s=args.segment_overhead_s,
+        per_slot_segment_s=args.per_slot_segment_s,
+    )
+    specs = {
+        "obs_on": dict(
+            trace_sample=1.0, trace_ring=64,
+            slo="ttft_p99=0.5,e2e_p99=2.0,error_rate=0.01,"
+                "availability=0.999",
+        ),
+        "obs_off": dict(trace_sample=0.0, windowed_metrics=False,
+                        flight_recorder=False),
+    }
+    arms = {}
+    surfaces = {}
+    for _rep in range(3):
+        for name, spec in specs.items():
+            state = ServeState(
+                FakeBackend(**backend_kw),
+                max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1000.0,
+                max_queue_depth=64,
+                inflight=True, slots=args.max_batch,
+                **spec,
+            )
+            server = make_server(state, "127.0.0.1", 0)
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            loop = closed_loop(
+                base, args.clients, args.per_client, args.deadline_s,
+                payload,
+            )
+            if name == "obs_on" and not surfaces:
+                # certify the armed surfaces against the live server once
+                u = urllib.parse.urlparse(base)
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=10)
+                conn.request("GET", "/debug/slo")
+                slo_d = json.loads(conn.getresponse().read())
+                conn.request("GET", "/v1/usage")
+                usage_d = json.loads(conn.getresponse().read())
+                conn.close()
+                recorder = state.recorder.stats_dict()
+                tenants = usage_d["tenants"]
+                surfaces = {
+                    "slo_objectives": len(slo_d["objectives"]),
+                    "slo_breached": slo_d["breached"],
+                    "usage_requests": sum(
+                        t["requests"] for t in tenants.values()
+                    ),
+                    "usage_generated_tokens": sum(
+                        t["generated_tokens"] for t in tenants.values()
+                    ),
+                    "recorder_events": recorder["events"],
+                }
+            server.shutdown()
+            server.server_close()
+            state.close()
+            best = arms.get(name)
+            if best is None or loop["goodput_rps"] > best["goodput_rps"]:
+                arms[name] = loop
+    on, off = arms["obs_on"], arms["obs_off"]
+    overhead_pct = (
+        round((off["goodput_rps"] - on["goodput_rps"])
+              / off["goodput_rps"] * 100.0, 2)
+        if off["goodput_rps"] else 0.0
+    )
+    return {
+        "workload": f"{args.clients} closed-loop clients x "
+                    f"{args.per_client} requests, r04 mixed in-flight "
+                    "shape, identical load both arms; obs_on = tracing + "
+                    "rolling windows + usage ledger + flight recorder + "
+                    "4-objective SLO engine, obs_off = all constructed "
+                    "away; best-of-3 per arm, reps interleaved",
+        "slo_spec": specs["obs_on"]["slo"],
+        **arms,
+        "surfaces": surfaces,
+        "slo_overhead_pct": overhead_pct,
+    }
+
+
 # -- main --------------------------------------------------------------------
 
 
@@ -1085,7 +1195,12 @@ def main(argv=None) -> int:
                         "costs more than this percentage of goodput "
                         "(sweeps on vs off, best-of-2; CI smoke passes a "
                         "softer floor for shared-runner jitter)")
-    p.add_argument("--out", default="BENCH_serving_r08.json")
+    p.add_argument("--slo-max-overhead-pct", type=float, default=2.0,
+                   help="exit non-zero when the full obs+SLO+usage+"
+                        "recorder arm costs more than this percentage of "
+                        "goodput vs the all-off arm (CI smoke passes a "
+                        "softer floor for shared-runner jitter)")
+    p.add_argument("--out", default="BENCH_serving_r09.json")
     p.add_argument("--min-speedup", type=float, default=4.0,
                    help="exit non-zero below this goodput ratio (CI smoke "
                         "passes a softer floor: shared 2-core runners get "
@@ -1219,6 +1334,10 @@ def main(argv=None) -> int:
     print("cancel phase ...", flush=True)
     cancel = cancel_phase(args)
 
+    # 11) production observability: full SLO+usage+recorder stack on/off
+    print("slo phase ...", flush=True)
+    slo = slo_phase(args)
+
     speedup = (
         serve_closed["goodput_rps"] / serial_closed["goodput_rps"]
         if serial_closed["goodput_rps"]
@@ -1259,6 +1378,7 @@ def main(argv=None) -> int:
         "sharded": sharded,
         "qos": qos,
         "cancel": cancel,
+        "slo": slo,
         "serving_stats": stats.to_dict(),
         # server-side histogram snapshots (vnsum_tpu.obs): bucket counts
         # plus bucket-derived p50/p95/p99 for queue wait, TTFT, e2e latency,
@@ -1321,6 +1441,14 @@ def main(argv=None) -> int:
         f"{cancel['idle_goodput_rps']} rps idle baseline); unused-path "
         f"overhead {cancel['cancel_overhead_pct']}%"
     )
+    print(
+        f"slo: full obs+SLO+usage+recorder overhead "
+        f"{slo['slo_overhead_pct']}% ({slo['obs_on']['goodput_rps']} vs "
+        f"{slo['obs_off']['goodput_rps']} rps; "
+        f"{slo['surfaces']['slo_objectives']} objectives evaluated, "
+        f"{slo['surfaces']['usage_requests']} requests in the usage "
+        f"ledger, {slo['surfaces']['recorder_events']} recorder events)"
+    )
     print(f"wrote {args.out}")
     ok = (
         speedup >= args.min_speedup
@@ -1344,6 +1472,13 @@ def main(argv=None) -> int:
         and cancel["recovery_ratio"] >= args.cancel_min_recovery
         and sum(cancel["cancels"].values()) > 0
         and cancel["cancel_overhead_pct"] <= args.cancel_max_overhead_pct
+        # full observability stack stays inside the overhead bar, and the
+        # armed arm's surfaces actually carried the load (a dormant "on"
+        # arm would make the A/B vacuous)
+        and slo["slo_overhead_pct"] <= args.slo_max_overhead_pct
+        and slo["surfaces"]["slo_objectives"] == 4
+        and slo["surfaces"]["usage_requests"] > 0
+        and slo["surfaces"]["recorder_events"] > 0
     )
     return 0 if ok else 1
 

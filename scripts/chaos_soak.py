@@ -622,6 +622,7 @@ def main(argv=None) -> int:
         "--fake-per-prompt-ms", str(args.fake_per_prompt_ms),
     ]
     server_env = None
+    flight_dir = None
     if args.qos:
         # in-flight + two tiers + real per-segment latency, so kills and
         # preemptions land mid-decode rather than between instant segments
@@ -630,6 +631,12 @@ def main(argv=None) -> int:
             "--tenants", "interactive:4:0,batch:1:0:batch",
             "--fake-segment-overhead-ms", "30",
         ]
+        # flight recorder: every process epoch dumps its typed-event ring
+        # on graceful drain (SIGKILLed epochs leave nothing — that is the
+        # point of the ring being in-memory); the final SIGTERM's drain
+        # dump is the one the audit below holds to account
+        flight_dir = str(Path(journal_dir) / "flight")
+        server_args += ["--flight-dir", flight_dir]
         server_env = {
             "VNSUM_CHAOS_PREEMPT_GAP_MS": str(args.preempt_gap_ms),
         }
@@ -640,6 +647,7 @@ def main(argv=None) -> int:
     # high-water mark within each process epoch and sum across restarts
     preempts_observed = 0
     epoch_high = 0
+    final_epoch_preempts = 0
 
     def sample_preempts() -> None:
         nonlocal epoch_high
@@ -693,6 +701,7 @@ def main(argv=None) -> int:
                 break
             time.sleep(0.2)
         driver.stop()
+        final_epoch_preempts = epoch_high
         preempts_observed += epoch_high
         pending = scrape_metric(port, "vnsum_serve_journal_pending")
         if pending != 0:
@@ -747,10 +756,55 @@ def main(argv=None) -> int:
         if e is not None and e.status == "complete" and e.text != text:
             client_vs_ledger.append(rid)
 
+    # flight-recorder audit (qos mode): the final graceful SIGTERM dumped
+    # the drain ring — assert a WELL-FORMED dump exists (reason + typed
+    # events with monotone seqs and the serving lifecycle in them), and
+    # that the preemption lifecycle is on the tape whenever the final
+    # process epoch actually preempted (earlier epochs die by SIGKILL —
+    # their in-memory rings are exactly what a black box cannot keep)
+    flight_ok = True
+    flight_summary: dict = {}
+    if args.qos:
+        dump_paths = sorted(Path(flight_dir).glob("flight_*.json"))
+        events: list[dict] = []
+        well_formed = bool(dump_paths)
+        for p in dump_paths:
+            try:
+                d = json.loads(p.read_text())
+                # explicit raises, not asserts: the audit must survive -O
+                if not (d["reason"] and isinstance(d["events"], list)):
+                    raise ValueError("missing reason / events list")
+                seqs = [e["seq"] for e in d["events"]]
+                if seqs != sorted(seqs):
+                    raise ValueError("event seqs not monotone")
+                if not all("kind" in e and "t_rel" in e
+                           for e in d["events"]):
+                    raise ValueError("untyped event on the tape")
+                events.extend(d["events"])
+            # lint-allow[swallowed-exception]: a malformed dump fails the audit via flight_ok below — recording the verdict IS the handling
+            except (KeyError, ValueError):
+                well_formed = False
+        kinds = {e["kind"] for e in events}
+        preempt_events = sum(1 for e in events if e["kind"] == "preempt")
+        flight_ok = (
+            well_formed
+            and {"admit", "dispatch"} <= kinds
+            and (final_epoch_preempts == 0 or preempt_events > 0)
+        )
+        flight_summary = {
+            "dumps": len(dump_paths),
+            "events": len(events),
+            "event_kinds": sorted(kinds),
+            "preempt_events": preempt_events,
+            "final_epoch_preemptions": final_epoch_preempts,
+            "well_formed": well_formed,
+        }
+
     record = {
         "bench": "chaos_soak_process_kill",
         "seed": args.seed,
         "qos": args.qos,
+        "flight_recorder": flight_summary,
         "preemptions_observed": preempts_observed,
         "schedule": schedule.describe(),
         "restarts": restarts,
@@ -784,6 +838,8 @@ def main(argv=None) -> int:
         # that never preempted proved nothing about the mid-preempt
         # kill window
         and (not args.qos or preempts_observed > 0)
+        # ...and must leave a well-formed flight-recorder dump behind
+        and flight_ok
     )
     print("ledger invariant:", "OK" if ok else "VIOLATED")
     if args.qos:

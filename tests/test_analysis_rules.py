@@ -427,6 +427,57 @@ def test_swallowed_exception_suppression_and_scope(tmp_path):
                      rules=["swallowed-exception"]) == []
 
 
+# -- metric-label-cardinality ------------------------------------------------
+
+
+LABEL_SRC = """
+    def render(self, lines, tenant, registry):
+        lines.append(f'x_total{{tenant="{tenant}"}} 1')            # raw: flagged
+        lines.append(f'y_total{{tenant="{registry.canonical(tenant)}"}} 1')
+        for stage in ("queued", "resident"):
+            lines.append(f'z_total{{stage="{stage}"}} 1')          # literal loop: fine
+        for reason in SomeEnum:
+            lines.append(f'w_total{{reason="{reason.value}"}} 1')  # enum .value: fine
+        lines.append(f'plain interpolation with no label {tenant}')
+"""
+
+
+def test_label_cardinality_flags_raw_dynamic_label_only(tmp_path):
+    findings = _serve_lint_rule(tmp_path, LABEL_SRC,
+                                ["metric-label-cardinality"])
+    assert len(findings) == 1
+    assert findings[0].rule == "metric-label-cardinality"
+    assert 'tenant="..."' in findings[0].message
+    assert "canonical" in findings[0].message
+
+
+def test_label_cardinality_scoped_to_serve(tmp_path):
+    # the same raw emission outside vnsum_tpu/serve/ is out of scope
+    f = tmp_path / "vnsum_tpu" / "obs" / "snippet.py"
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(LABEL_SRC), encoding="utf-8")
+    assert run_paths([f], root=tmp_path,
+                     rules=["metric-label-cardinality"]) == []
+
+
+def test_label_cardinality_suppression_with_reason_clears(tmp_path):
+    src = LABEL_SRC.replace(
+        "lines.append(f'x_total{{tenant=\"{tenant}\"}} 1')",
+        "# lint-allow[metric-label-cardinality]: fixture set is bounded\n"
+        "        lines.append(f'x_total{{tenant=\"{tenant}\"}} 1')",
+    )
+    assert _serve_lint_rule(tmp_path, src,
+                            ["metric-label-cardinality"]) == []
+
+
+def _serve_lint_rule(tmp_path, src: str, rules):
+    d = tmp_path / "vnsum_tpu" / "serve"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "snippet.py"
+    f.write_text(textwrap.dedent(src), encoding="utf-8")
+    return run_paths([f], root=tmp_path, rules=rules)
+
+
 # -- durable-write -----------------------------------------------------------
 
 

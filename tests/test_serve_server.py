@@ -54,6 +54,34 @@ def test_healthz(serve_url):
     assert d["queue_depth"] == 0 and d["closed"] is False
 
 
+def test_healthz_schema_regression(serve_url):
+    """The /healthz response schema is a contract probes parse: the
+    uptime/version/start-stamp satellite fields must keep their names and
+    types, and the SLO line appears exactly when --slo is configured."""
+    import re
+
+    base, _ = serve_url
+    _, body = _get(base + "/healthz")
+    d = json.loads(body)
+    # field presence + types
+    assert isinstance(d["uptime_s"], (int, float)) and d["uptime_s"] >= 0
+    assert isinstance(d["version"], str) and d["version"]
+    from vnsum_tpu import __version__
+
+    assert d["version"] == __version__
+    # start wall-clock stamp: ISO seconds resolution, explicitly UTC
+    assert re.fullmatch(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z",
+                        d["started_at"])
+    # no --slo -> no slo line (probes must not see a phantom verdict)
+    assert "slo" not in d
+    # uptime advances between polls
+    import time as _time
+
+    _time.sleep(0.05)
+    _, body = _get(base + "/healthz")
+    assert json.loads(body)["uptime_s"] >= d["uptime_s"]
+
+
 def test_generate_single_and_batch(serve_url):
     base, state = serve_url
     status, d = _post(base + "/v1/generate", {"prompt": "xin chào " * 10})

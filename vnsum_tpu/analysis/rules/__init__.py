@@ -7,6 +7,7 @@ from . import (  # noqa: F401
     durable,
     guarded_by,
     host_sync,
+    label_cardinality,
     metrics_doc,
     recompile,
     swallowed,

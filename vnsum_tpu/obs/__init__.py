@@ -10,7 +10,7 @@ Looping (arXiv:2410.23668) shows dominating peak inference, and BASS
 (arXiv:2404.15778) shows batched speculation only pays when acceptance is
 measured per batch, not spot-checked.
 
-Four pieces, one span model:
+Six pieces, one span model:
 
 - :mod:`trace`     — `RequestTrace` (request id carried across the HTTP ->
                      queue -> scheduler -> engine thread handoffs),
@@ -21,6 +21,13 @@ Four pieces, one span model:
                      bucket-derived percentiles (p50/p95/p99 in bench JSON)
 - :mod:`telemetry` — rolling-window ratios for "now" gauges (rolling
                      spec acceptance, rolling tokens/s)
+- :mod:`window`    — ring-of-sub-windows histograms/counters: "last
+                     minute" quantiles and counts with the cumulative
+                     histogram's observe cost — the SLO engine's
+                     (`serve/slo.py`) and usage ledger's substrate
+- :mod:`recorder`  — the flight recorder: a bounded ring of typed
+                     lifecycle events, dumped atomically on anomalies
+                     (brownout, fatal, quarantine, SLO fast-burn, drain)
 - :mod:`export`    — Chrome trace-event JSON (loads in chrome://tracing and
                      ui.perfetto.dev): one track per request, one per
                      engine batch; `save_chrome_trace` drops the dump next
@@ -32,6 +39,7 @@ scheduler (span recording + TTFT), `backend/engine.py` and `backend/fake.py`
 same `SpanRecorder`), and the `/debug/trace` endpoint (`serve/server.py`).
 """
 from .histogram import Histogram
+from .recorder import FlightRecorder
 from .telemetry import Rolling
 from .trace import (
     BatchTrace,
@@ -44,15 +52,19 @@ from .trace import (
     reset_collector,
     set_collector,
 )
+from .window import WindowedCounter, WindowedHistogram
 
 __all__ = [
     "BatchTrace",
+    "FlightRecorder",
     "Histogram",
     "ObsHub",
     "RequestTrace",
     "Rolling",
     "Span",
     "SpanRecorder",
+    "WindowedCounter",
+    "WindowedHistogram",
     "current_collector",
     "emit",
     "reset_collector",

@@ -27,6 +27,11 @@ E2E_BUCKETS_S = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                  30.0, 60.0)
 OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 ACCEPT_BUCKETS = (0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0)
+# a /metrics render costs tens of microseconds to low milliseconds — a
+# self-metric on the WAIT ladder (floor 1ms) would put every scrape in the
+# first bucket and report nothing
+SCRAPE_BUCKETS_S = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+                    5e-3, 0.01, 0.025, 0.05, 0.1)
 
 
 def _fmt(v: float) -> str:
@@ -64,6 +69,51 @@ class Histogram:
                 return
         self.counts[-1] += 1
 
+    def reset(self) -> None:
+        """Zero in place (no allocation — `obs/window.py` recycles expired
+        sub-windows through here on the observe path)."""
+        for i in range(len(self.counts)):
+            self.counts[i] = 0
+        self.sum = 0.0
+        self.count = 0
+
+    def merge_from(self, other: "Histogram") -> None:
+        """Add ``other``'s counts into this histogram (same bounds required)
+        — how `obs/window.WindowedHistogram` folds its live sub-windows into
+        one readable histogram."""
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different bounds")
+        for i, n in enumerate(other.counts):
+            self.counts[i] += n
+        self.sum += other.sum
+        self.count += other.count
+
+    def bucket_index(self, value: float) -> int:
+        """Index of the bucket ``value`` falls in (len(bounds) = +Inf tail)."""
+        for i, ub in enumerate(self.bounds):
+            if value <= ub:
+                return i
+        return len(self.bounds)
+
+    def fraction_le(self, x: float) -> float:
+        """Fraction of observations <= ``x``, interpolated inside the bucket
+        ``x`` falls in — the compliance estimator the SLO engine
+        (`serve/slo.py`) judges latency objectives with. The +Inf tail is
+        conservatively counted as ABOVE any finite ``x`` (an observation
+        past the top bound is a violation we cannot bound). Empty histogram
+        = vacuous compliance (1.0)."""
+        if not self.count:
+            return 1.0
+        cum = 0
+        lo = 0.0
+        for i, ub in enumerate(self.bounds):
+            if x < ub:
+                frac = (x - lo) / (ub - lo) if ub > lo else 1.0
+                return (cum + self.counts[i] * max(min(frac, 1.0), 0.0)) / self.count
+            cum += self.counts[i]
+            lo = ub
+        return cum / self.count
+
     def percentile(self, q: float) -> float:
         """Quantile estimate from the buckets (histogram_quantile rules):
         find the bucket where the cumulative count crosses ``q * count``,
@@ -85,16 +135,29 @@ class Histogram:
 
     # -- export ----------------------------------------------------------
 
-    def render(self, name: str, help_: str) -> list[str]:
+    def render(self, name: str, help_: str,
+               exemplars: list | None = None) -> list[str]:
         """Prometheus text-format lines: HELP/TYPE then cumulative
-        ``_bucket{le=...}`` rows, ``_sum``, ``_count``."""
+        ``_bucket{le=...}`` rows, ``_sum``, ``_count``. ``exemplars`` is an
+        optional per-bucket list of (trace_id, value, t) tuples (see
+        `obs/window.WindowedHistogram.exemplars`): buckets with one get the
+        OpenMetrics-style ``# {trace_id="..."} value`` suffix that links a
+        bad latency bucket straight to its request in ``/debug/trace``."""
         lines = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
         cum = 0
-        for ub, n in zip(self.bounds, self.counts):
+        for i, (ub, n) in enumerate(zip(self.bounds, self.counts)):
             cum += n
-            lines.append(f'{name}_bucket{{le="{_fmt(ub)}"}} {cum}')
+            line = f'{name}_bucket{{le="{_fmt(ub)}"}} {cum}'
+            if exemplars is not None and i < len(exemplars) and exemplars[i]:
+                ex_id, ex_val, _t = exemplars[i]
+                line += f' # {{trace_id="{ex_id}"}} {round(ex_val, 6)}'
+            lines.append(line)
         cum += self.counts[-1]
-        lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+        tail = f'{name}_bucket{{le="+Inf"}} {cum}'
+        if exemplars is not None and exemplars[-1]:
+            ex_id, ex_val, _t = exemplars[-1]
+            tail += f' # {{trace_id="{ex_id}"}} {round(ex_val, 6)}'
+        lines.append(tail)
         lines.append(f"{name}_sum {round(self.sum, 6)}")
         lines.append(f"{name}_count {cum}")
         return lines

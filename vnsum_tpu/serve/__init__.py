@@ -48,11 +48,21 @@ This package is the missing online front-end for the batched engine:
                 rides the journal
 - metrics.py    per-request + aggregate observability: counters, rolling
                 gauges, and fixed-bucket histograms (queue wait / TTFT /
-                e2e / occupancy / accepted-per-step) in Prometheus text;
-                ONE metric registry, linted against the README table
+                e2e / occupancy / accepted-per-step) in Prometheus text,
+                plus rolling windows (obs/window.py) feeding the SLO
+                engine and per-tenant ledger; ONE metric registry, linted
+                against the README table
+- slo.py        declarative SLOs over the rolling windows (--slo):
+                latency-quantile / error-rate / availability objectives,
+                fast+slow burn rates, edge-triggered breaches that fire
+                the flight recorder; /debug/slo + vnsum_serve_slo_* gauges
+- usage.py      per-tenant usage ledger behind the capped
+                TenantLabelRegistry (bounded metric cardinality): token/
+                outcome counters + windowed latency per tenant, served at
+                /v1/usage and as tenant-labeled series
 - server.py     stdlib HTTP front-end: /v1/summarize, /v1/generate,
-                /healthz, /metrics, /debug/trace (Perfetto-loadable
-                Chrome trace JSON)  (python -m vnsum_tpu.serve.server)
+                /healthz, /metrics, /v1/usage, /debug/trace, /debug/slo,
+                /debug/flightrecorder  (python -m vnsum_tpu.serve.server)
 
 The engine itself is untouched: ONE scheduler thread owns all
 backend.generate calls (TpuBackend's jit caches and stats are not
@@ -70,7 +80,9 @@ from .inflight import InflightScheduler
 from .journal import JournalEntry, RequestJournal
 from .metrics import ServeMetrics
 from .qos import TenantSpec, TenantTable, TokenBucket, parse_tenant_specs
+from .slo import Objective, SloEngine, parse_slo_spec
 from .stream import StreamChannel, StreamDetached, StreamRegistry
+from .usage import TenantLabelRegistry, UsageLedger
 from .supervisor import (
     EngineSupervisor,
     FailureClass,
@@ -87,6 +99,7 @@ __all__ = [
     "InflightScheduler",
     "JournalEntry",
     "MicroBatchScheduler",
+    "Objective",
     "RequestJournal",
     "QueuedBackend",
     "RequestCancelled",
@@ -98,11 +111,15 @@ __all__ = [
     "ServeMetrics",
     "ServeRequest",
     "ShedReason",
+    "SloEngine",
     "StreamChannel",
     "StreamDetached",
     "StreamRegistry",
+    "TenantLabelRegistry",
     "TenantSpec",
     "TenantTable",
     "TokenBucket",
+    "UsageLedger",
+    "parse_slo_spec",
     "parse_tenant_specs",
 ]

@@ -224,7 +224,8 @@ class InflightScheduler(MicroBatchScheduler):
                 total_s=max(now - r.enqueued_at, 0.0),
                 prompt_tokens=r.est_tokens,
             )
-            self.metrics.observe_request(rec)
+            self.metrics.observe_request(rec, tenant=r.tenant)
+            self._fr("failed", rid=r.trace_id, reason="error")
             self._trace_request(r, t0, max(now - t0, 0.0), None, "error")
             self._release_preempt_pins(r)
             self._journal_fail(r, "error", str(e))
@@ -377,12 +378,15 @@ class InflightScheduler(MicroBatchScheduler):
                 r.preempt_pins.append(ev.pin)
             if self.journal is not None and r.journal_rid is not None:
                 self.journal.preempt(r.journal_rid)
-            self.metrics.observe_preemption()
+            self.metrics.observe_preemption(tenant=r.tenant)
+            self._fr("preempt", rid=r.trace_id, tenant=r.tenant,
+                     preemptions=r.preemptions)
             self._trace_fault(r, "preempt", None, 0.0)
             self.queue.requeue(r)
             if self.journal is not None and r.journal_rid is not None:
                 self.journal.requeue(r.journal_rid)
-            self.metrics.observe_requeue()
+            self.metrics.observe_requeue(tenant=r.tenant)
+            self._fr("requeue", rid=r.trace_id, tenant=r.tenant)
         logger.info(
             "preempted %d batch-tier resident(s) for interactive demand",
             len(evictions),
@@ -450,6 +454,13 @@ class InflightScheduler(MicroBatchScheduler):
         if admissions:
             prefill_s = admissions[0].prefill_end - admissions[0].admitted_at
             self.metrics.observe_batch(len(admissions), prefill_s)
+            if self.recorder is not None:
+                # guarded, not _fr: the riders list must not be built on
+                # the recorder-less hot path (the all-off arm's contract)
+                self.recorder.record(
+                    "dispatch", rid=admissions[0].key.trace_id,
+                    occupancy=len(admissions), slot_admit=True,
+                    rids=[a.key.trace_id for a in admissions[1:]])
             if was_running:
                 self.metrics.observe_refill(len(admissions))
         if rejected:
@@ -502,7 +513,8 @@ class InflightScheduler(MicroBatchScheduler):
             rec.cached_prompt_tokens = (
                 adm.cached_tokens if adm is not None else 0
             )
-            self.metrics.observe_request(rec)
+            self.metrics.observe_request(rec, tenant=r.tenant)
+            self._fr("complete", rid=r.trace_id, gen_tokens=c.gen_tokens)
             self._trace_request(r, t_admit, engine_s, None, "ok")
             self._release_preempt_pins(r)
             if r.stream is not None:

@@ -35,11 +35,14 @@ from ..obs.histogram import (
     E2E_BUCKETS_S,
     Histogram,
     OCCUPANCY_BUCKETS,
+    SCRAPE_BUCKETS_S,
     TTFT_BUCKETS_S,
     WAIT_BUCKETS_S,
 )
 from ..obs.telemetry import Rolling
+from ..obs.window import WindowedCounter, WindowedHistogram
 from .queue import ShedReason
+from .usage import TenantLabelRegistry, UsageLedger
 
 _PREFIX = "vnsum_serve_"
 _METRICS: dict[str, tuple[str, str]] = {}  # short name -> (type, help)
@@ -56,8 +59,6 @@ _reg("requests_completed_total", "counter", "requests answered")
 _reg("requests_errored_total", "counter", "requests failed in the engine")
 _reg("requests_shed_total", "counter", "requests shed, by reason")
 _reg("batches_total", "counter", "engine batches dispatched")
-_reg("batch_occupancy_sum", "counter",
-     "sum of engine batch occupancies (avg = sum / batches_total)")
 _reg("engine_seconds_total", "counter",
      "wall-clock seconds spent inside backend.generate")
 _reg("queue_wait_seconds_total", "counter",
@@ -166,6 +167,61 @@ _reg("journal_replay_seconds_total", "counter",
      "wall-clock seconds spent re-enqueueing journaled requests")
 _reg("journal_pending", "gauge",
      "journaled requests not yet COMPLETE or typed FAILED (scrape-time)")
+# -- SLO engine (serve/slo.py): declarative objectives over the rolling
+# windows, evaluated per objective with fast/slow burn rates
+_reg("slo_compliance", "gauge",
+     "fraction of the objective's window meeting its target, by objective")
+_reg("slo_error_budget_remaining", "gauge",
+     "unburned fraction of the objective's error budget over the slow "
+     "window (0 = fully burned), by objective")
+_reg("slo_burn_rate", "gauge",
+     "error-budget burn rate (1.0 = burning exactly the budget), by "
+     "objective and window (fast/slow)")
+_reg("slo_breached", "gauge",
+     "1 while any objective's fast AND slow burn rates exceed the breach "
+     "thresholds, else 0")
+_reg("slo_breaches_total", "counter",
+     "objective breach transitions (edge-triggered; each fires the flight "
+     "recorder)")
+# -- per-tenant usage ledger (serve/usage.py): labels pass through the
+# capped TenantLabelRegistry, so cardinality is bounded by construction
+_reg("usage_requests_total", "counter", "requests admitted, by tenant")
+_reg("usage_completed_total", "counter", "requests answered ok, by tenant")
+_reg("usage_errors_total", "counter", "requests failed, by tenant")
+_reg("usage_sheds_total", "counter", "requests shed, by tenant")
+_reg("usage_cancels_total", "counter",
+     "requests terminally cancelled, by tenant")
+_reg("usage_preemptions_total", "counter",
+     "slot evictions suffered, by tenant")
+_reg("usage_requeues_total", "counter",
+     "preempted requests re-admitted, by tenant")
+_reg("usage_prompt_tokens_total", "counter", "prompt tokens, by tenant")
+_reg("usage_generated_tokens_total", "counter",
+     "generated tokens, by tenant")
+_reg("usage_cached_tokens_total", "counter",
+     "prompt tokens served from the prefix cache (the tenant's cache "
+     "savings), by tenant")
+_reg("usage_ttft_p99_seconds", "gauge",
+     "anchored TTFT p99 over the fast window, by tenant")
+_reg("usage_e2e_p99_seconds", "gauge",
+     "end-to-end latency p99 over the fast window, by tenant")
+_reg("usage_queue_wait_p99_seconds", "gauge",
+     "queue-wait p99 over the fast window, by tenant")
+_reg("usage_tenants_overflowed", "gauge",
+     "distinct tenant names collapsed into the 'other' overflow label by "
+     "the capped registry (cardinality pressure probe)")
+# -- flight recorder (obs/recorder.py)
+_reg("recorder_events_total", "counter",
+     "typed lifecycle events appended to the flight-recorder ring")
+_reg("recorder_events_dropped_total", "counter",
+     "flight-recorder events evicted by the bounded ring")
+_reg("recorder_dumps_total", "counter",
+     "anomaly-triggered flight-recorder dumps written")
+# -- scrape self-observation (satellite: /metrics cost made observable)
+_reg("scrape_seconds", "histogram",
+     "wall-clock cost of rendering /metrics (state is snapshotted under "
+     "the metrics lock, rendered outside it; each scrape reports the "
+     "distribution up to and including the PREVIOUS one)")
 _reg("queue_depth", "gauge", "requests currently queued")
 _reg("queued_tokens", "gauge",
      "billable (uncached) prompt-token estimate currently queued")
@@ -198,9 +254,14 @@ class ServeMetrics:
     the pricier per-span tracing lives in obs.ObsHub behind --trace-sample.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, windowed: bool = True, horizon_s: float = 600.0,
+                 sub_windows: int = 60, tenant_labels=None,
+                 clock=None) -> None:
+        import time as _time
+
         # lock-order-sanitizer hook: plain threading.Lock in production
         self._lock = make_lock("serve.metrics")
+        self._clock = clock or _time.monotonic
         self._stats = ServingStats()            # guarded by: _lock
         self._hists = {                         # guarded by: _lock
             "queue_wait_seconds": Histogram(WAIT_BUCKETS_S),
@@ -212,17 +273,63 @@ class ServeMetrics:
         }
         self._rolling_accept = Rolling(256)     # guarded by: _lock
         self._rolling_tps = Rolling(256)        # guarded by: _lock
+        # the capped label funnel every dynamically-labeled series routes
+        # through; constructed even with windowed=False (the qos labels use
+        # it too). Seed it with declared tenants via seed_tenants() so a
+        # table tenant can never lose its label to earlier hostile names
+        self.tenant_labels = tenant_labels or TenantLabelRegistry()
+        # rolling windows (obs/window.py): the SLO engine's and the usage
+        # ledger's substrate. windowed=False (bench all-off arm) constructs
+        # none of it — the observe paths then pay only `is None` checks
+        self._win: dict[str, WindowedHistogram] | None = None  # guarded by: _lock
+        self._win_counts: WindowedCounter | None = None        # guarded by: _lock
+        self.usage: UsageLedger | None = None                  # guarded by: _lock
+        if windowed:
+            kw = dict(horizon_s=horizon_s, sub_windows=sub_windows,
+                      clock=self._clock)
+            self._win = {
+                "queue_wait_seconds": WindowedHistogram(WAIT_BUCKETS_S, **kw),
+                "ttft_seconds": WindowedHistogram(TTFT_BUCKETS_S, **kw),
+                "e2e_seconds": WindowedHistogram(E2E_BUCKETS_S, **kw),
+            }
+            self._win_counts = WindowedCounter(**kw)
+            self.usage = UsageLedger(registry=self.tenant_labels,
+                                     horizon_s=horizon_s,
+                                     sub_windows=sub_windows,
+                                     clock=self._clock)
+        # scrape self-observation: each render times itself and observes
+        # here AFTER releasing the lock for the render proper, so a scrape
+        # reports the distribution up to and including the previous one
+        self._scrape_hist = Histogram(SCRAPE_BUCKETS_S)  # guarded by: _lock
+        # window the per-tenant latency gauges report over (the SLO fast
+        # window; ServeState aligns it with --slo-fast-s)
+        self.usage_window_s = 60.0
+
+    def seed_tenants(self, names) -> None:
+        """Reserve registry labels for declared tenants (the --tenants
+        table) ahead of any traffic — unconditionally (`track`), so a
+        declared tenant's series can never collapse into `other`."""
+        with self._lock:
+            for name in names:
+                self.tenant_labels.track(name)
 
     # -- observation hooks ----------------------------------------------
 
-    def observe_submit(self, n: int = 1) -> None:
+    def observe_submit(self, n: int = 1, tenant: str = "") -> None:
         with self._lock:
             self._stats.submitted += n
+            if self.usage is not None:
+                self.usage.observe_submit(tenant, n)
 
-    def observe_shed(self, reason: ShedReason, n: int = 1) -> None:
+    def observe_shed(self, reason: ShedReason, n: int = 1,
+                     tenant: str = "") -> None:
         with self._lock:
             key = reason.value
             self._stats.shed[key] = self._stats.shed.get(key, 0) + n
+            if self._win_counts is not None:
+                self._win_counts.add("shed", n)
+            if self.usage is not None:
+                self.usage.observe_shed(tenant, n)
 
     def observe_batch(self, occupancy: int, engine_s: float,
                       gen_tokens: int = 0) -> None:
@@ -287,13 +394,17 @@ class ServeMetrics:
             q = self._stats.quota_sheds
             q[tenant] = q.get(tenant, 0) + n
 
-    def observe_preemption(self, n: int = 1) -> None:
+    def observe_preemption(self, n: int = 1, tenant: str = "") -> None:
         with self._lock:
             self._stats.preemptions += n
+            if self.usage is not None:
+                self.usage.observe_preemption(tenant, n)
 
-    def observe_requeue(self, n: int = 1) -> None:
+    def observe_requeue(self, n: int = 1, tenant: str = "") -> None:
         with self._lock:
             self._stats.requeues += n
+            if self.usage is not None:
+                self.usage.observe_requeue(tenant, n)
 
     def observe_stream_request(self, n: int = 1) -> None:
         with self._lock:
@@ -313,13 +424,16 @@ class ServeMetrics:
 
     # -- cancellation / stream-hardening hooks ----------------------------
 
-    def observe_cancel(self, stage: str, n: int = 1) -> None:
+    def observe_cancel(self, stage: str, n: int = 1,
+                       tenant: str = "") -> None:
         """One terminal cancellation, keyed by the lifecycle stage it
         landed in: queued (never dispatched), dispatched (one-shot batch in
         the engine), resident (evicted from a decode slot)."""
         with self._lock:
             c = self._stats.cancelled
             c[stage] = c.get(stage, 0) + n
+            if self.usage is not None:
+                self.usage.observe_cancel(tenant, n)
 
     def observe_cancel_disconnect(self, n: int = 1) -> None:
         with self._lock:
@@ -348,7 +462,8 @@ class ServeMetrics:
             else:
                 self._stats.degraded_recoveries += 1
 
-    def observe_request(self, rec: ServeRequestRecord) -> None:
+    def observe_request(self, rec: ServeRequestRecord,
+                        tenant: str = "") -> None:
         with self._lock:
             if rec.status == "ok":
                 self._stats.completed += 1
@@ -374,6 +489,27 @@ class ServeMetrics:
                 self._hists["spec_accepted_per_step"].observe(
                     rec.accepted_tokens / rec.spec_steps
                 )
+            # rolling windows + usage ledger (the SLO/usage substrate):
+            # same honesty rules as the cumulative histograms, plus the
+            # trace_id as the per-bucket exemplar so a bad windowed p99
+            # links straight to /debug/trace
+            if self._win is not None:
+                self._win["queue_wait_seconds"].observe(
+                    rec.queue_wait_s, exemplar=rec.trace_id
+                )
+                if rec.status == "ok":
+                    self._win_counts.add("completed")
+                    if rec.ttft_anchored:
+                        self._win["ttft_seconds"].observe(
+                            rec.ttft_s, exemplar=rec.trace_id
+                        )
+                    self._win["e2e_seconds"].observe(
+                        rec.total_s, exemplar=rec.trace_id
+                    )
+                elif rec.status == "error":
+                    self._win_counts.add("errors")
+            if self.usage is not None:
+                self.usage.observe_request(tenant, rec)
 
     # -- export ----------------------------------------------------------
 
@@ -388,6 +524,47 @@ class ServeMetrics:
         with self._lock:
             return {k: h.to_dict() for k, h in self._hists.items()}
 
+    def now(self) -> float:
+        """The metrics' own clock — callers taking multiple window views
+        that must agree (the SLO engine's fast+slow reads) resolve ONE
+        moment here and pass it to each."""
+        return self._clock()
+
+    def window_view(self, window_s: float | None = None,
+                    now: float | None = None) -> dict | None:
+        """Merged rolling-window state for the SLO engine (serve/slo.py):
+        {"hists": {name: Histogram}, "counts": {...}, "exemplars": {...}}
+        over the most recent ``window_s`` — or None when windows are off
+        (windowed=False). One lock hold AND one resolved ``now`` for the
+        whole view, so a burn-rate evaluation never mixes two moments (a
+        sub-window boundary between two merges would otherwise give the
+        latency hists and the error counts different window sets)."""
+        with self._lock:
+            if self._win is None:
+                return None
+            if now is None:
+                now = self._clock()
+            return {
+                "hists": {
+                    k: wh.merged(window_s, now)
+                    for k, wh in self._win.items()
+                },
+                "counts": self._win_counts.totals(window_s, now),
+                "exemplars": {
+                    k: wh.exemplars(window_s, now)
+                    for k, wh in self._win.items()
+                },
+            }
+
+    def usage_snapshot(self, window_s: float | None = None) -> dict | None:
+        """Per-tenant ledger for ``GET /v1/usage`` (None when windows are
+        off). Latency quantiles cover ``window_s`` (default: the whole
+        horizon)."""
+        with self._lock:
+            if self.usage is None:
+                return None
+            return self.usage.snapshot(window_s)
+
     def render_prometheus(self, queue_depth: int | None = None,
                           queued_tokens: int | None = None,
                           cache_stats: dict | None = None,
@@ -395,7 +572,10 @@ class ServeMetrics:
                           degraded_rung: int | None = None,
                           journal_stats: dict | None = None,
                           mesh_state: dict | None = None,
-                          qos_state: dict | None = None) -> str:
+                          qos_state: dict | None = None,
+                          slo_state: dict | None = None,
+                          recorder_stats: dict | None = None,
+                          exemplars: bool = False) -> str:
         """``cache_stats`` is the backend's prefix_cache_stats() snapshot
         (evictions / blocks_used / blocks_total), read at scrape time like
         the queue gauges — the serving layer never mirrors pool state.
@@ -403,9 +583,22 @@ class ServeMetrics:
         plus replica_occupancy when the in-flight loop is live).
         ``qos_state`` is TenantTable.stats() (per-tenant config + bucket
         levels), read from the live table at scrape time — absent entirely
-        on servers without a tenant table."""
+        on servers without a tenant table. ``slo_state`` is
+        SloEngine.export_state() (absent without --slo); ``recorder_stats``
+        the FlightRecorder's stats_dict (absent without a recorder).
+        ``exemplars=True`` suffixes the latency buckets with OpenMetrics
+        exemplars — callers must only set it for scrapes that NEGOTIATED
+        the OpenMetrics format (the classic text-format parser rejects a
+        trailing ``# {...}`` after a sample and drops the whole scrape).
+
+        Scrape discipline (the /metrics cost satellite): ALL owned state is
+        snapshotted in ONE lock hold, the text renders outside it, and the
+        render's own wall clock lands in the scrape_seconds histogram — so
+        an expensive scrape shows up in the very surface it serves and can
+        never stall the observe hot paths for its render phase."""
         import copy
 
+        t_scrape = self._clock()
         # one lock acquisition for stats AND histograms: a scrape must not
         # see a histogram count that disagrees with the counters it shipped
         # with
@@ -414,6 +607,20 @@ class ServeMetrics:
             hists = {k: h.copy() for k, h in self._hists.items()}
             rolling_accept = self._rolling_accept.rate()
             rolling_tps = self._rolling_tps.rate()
+            scrape_hist = self._scrape_hist.copy()
+            # recent-window exemplars ride the CUMULATIVE latency buckets:
+            # recent trace ids are the useful breadcrumbs, and the windowed
+            # structures are where they live
+            bucket_exemplars = (
+                {k: self._win[k].exemplars()
+                 for k in ("ttft_seconds", "e2e_seconds")}
+                if exemplars and self._win is not None else {}
+            )
+            usage_rows = (
+                self.usage.snapshot(self.usage_window_s)
+                if self.usage is not None else None
+            )
+            labels_overflowed = self.tenant_labels.overflowed
         lines = []
 
         def simple(name, value):
@@ -434,7 +641,10 @@ class ServeMetrics:
                 f"{s.shed.get(reason.value, 0)}"
             )
         simple("batches_total", s.batches)
-        simple("batch_occupancy_sum", s.batch_occupancy_sum)
+        # NOTE batch_occupancy_sum is deliberately NOT a standalone series:
+        # the batch_occupancy histogram's _sum sample carries the identical
+        # number, and the duplicate sample name made Prometheus (and the
+        # strict OpenMetrics parser) reject the whole scrape
         simple("engine_seconds_total", round(s.engine_seconds, 6))
         simple("queue_wait_seconds_total", round(s.queue_wait_seconds, 6))
         simple("prompt_tokens_total", s.prompt_tokens)
@@ -486,31 +696,113 @@ class ServeMetrics:
         simple("stream_backpressure_coalesced_total", s.stream_coalesced)
         simple("stream_resumes_total", s.stream_resumes)
         simple("stream_heartbeats_total", s.stream_heartbeats)
+        headered: set = set()
+
+        def labeled(name, label_val, value):
+            # THE tenant-labeled emission path: every dynamic tenant label
+            # funnels through the capped registry (the metric-label-
+            # cardinality lint pins this), so hostile names collapse into
+            # "other" instead of growing the scrape. Header dedup is a set
+            # probe, not a scan of the whole exposition — the usage block
+            # emits up to 13 series per tenant on the very path the
+            # scrape_seconds self-metric is watching
+            typ, help_ = _METRICS[name]
+            if name not in headered:
+                headered.add(name)
+                lines.append(f"# HELP {_PREFIX}{name} {help_}")
+                lines.append(f"# TYPE {_PREFIX}{name} {typ}")
+            lines.append(
+                f'{_PREFIX}{name}'
+                f'{{tenant="{self.tenant_labels.canonical(label_val, touch=False)}"}} '
+                f'{value}'
+            )
+
         if qos_state is not None:
             # per-tenant series, read from the live TenantTable at scrape
             # time like the queue gauges — the metrics layer never mirrors
             # bucket state. Label sets are the DECLARED tenants, so
-            # dashboards see every series from the first scrape
+            # dashboards see every series from the first scrape. Loops are
+            # FAMILY-outer, tenant-inner: OpenMetrics requires one family's
+            # samples to be contiguous (a tenant-outer loop interleaves
+            # families and a strict OM parser drops the whole scrape)
             simple("qos_tenants", len(qos_state))
-
-            def labeled(name, label_val, value):
-                typ, help_ = _METRICS[name]
-                header = f"# HELP {_PREFIX}{name} {help_}"
-                if header not in lines:
-                    lines.append(header)
-                    lines.append(f"# TYPE {_PREFIX}{name} {typ}")
-                lines.append(
-                    f'{_PREFIX}{name}{{tenant="{label_val}"}} {value}'
-                )
-
-            for tenant in sorted(qos_state):
-                t = qos_state[tenant]
+            qos_tenants = sorted(qos_state)
+            for tenant in qos_tenants:
                 labeled("qos_requests_total", tenant,
                         s.tenant_requests.get(tenant, 0))
+            for tenant in qos_tenants:
                 labeled("qos_quota_sheds_total", tenant,
                         s.quota_sheds.get(tenant, 0))
-                if t.get("bucket_tokens") is not None:
-                    labeled("qos_bucket_tokens", tenant, t["bucket_tokens"])
+            for tenant in qos_tenants:
+                if qos_state[tenant].get("bucket_tokens") is not None:
+                    labeled("qos_bucket_tokens", tenant,
+                            qos_state[tenant]["bucket_tokens"])
+        if usage_rows is not None:
+            # the per-tenant usage ledger (serve/usage.py): keys are already
+            # canonical (the ledger itself is registry-keyed), counters are
+            # monotone, latency gauges cover the fast window. Family-outer
+            # like the qos block (OM sample contiguity)
+            simple("usage_tenants_overflowed", labels_overflowed)
+            for family, value_of in (
+                ("usage_requests_total", lambda u: u["requests"]),
+                ("usage_completed_total", lambda u: u["completed"]),
+                ("usage_errors_total", lambda u: u["errors"]),
+                ("usage_sheds_total", lambda u: u["sheds"]),
+                ("usage_cancels_total", lambda u: u["cancels"]),
+                ("usage_preemptions_total", lambda u: u["preemptions"]),
+                ("usage_requeues_total", lambda u: u["requeues"]),
+                ("usage_prompt_tokens_total", lambda u: u["prompt_tokens"]),
+                ("usage_generated_tokens_total",
+                 lambda u: u["generated_tokens"]),
+                ("usage_cached_tokens_total",
+                 lambda u: u["cached_tokens_saved"]),
+                ("usage_ttft_p99_seconds", lambda u: u["ttft"]["p99_s"]),
+                ("usage_e2e_p99_seconds", lambda u: u["e2e"]["p99_s"]),
+                ("usage_queue_wait_p99_seconds",
+                 lambda u: u["queue_wait"]["p99_s"]),
+            ):
+                for tenant in sorted(usage_rows):
+                    labeled(family, tenant, value_of(usage_rows[tenant]))
+        if slo_state is not None:
+            # SLO engine gauges (serve/slo.py), computed from the rolling
+            # windows at evaluation time and handed in at scrape time like
+            # every other live-subsystem state
+            simple("slo_breached", 1 if slo_state.get("breached") else 0)
+            simple("slo_breaches_total", slo_state.get("breaches_total", 0))
+
+            def slo_labeled(metric, objective, value, extra=""):
+                typ, help_ = _METRICS[metric]
+                if metric not in headered:
+                    headered.add(metric)
+                    lines.append(f"# HELP {_PREFIX}{metric} {help_}")
+                    lines.append(f"# TYPE {_PREFIX}{metric} {typ}")
+                # lint-allow[metric-label-cardinality]: objective names are parse-time-validated --slo spec tokens — a bounded, operator-declared set, not request-derived
+                lines.append(f'{_PREFIX}{metric}{{objective="{objective}"'
+                             f'{extra}}} {value}')
+
+            # family-outer like the tenant blocks (OM sample contiguity);
+            # both burn windows share one family, so they ride one loop
+            objective_names = sorted(slo_state.get("objectives", {}))
+            for name in objective_names:
+                slo_labeled("slo_compliance", name,
+                            round(slo_state["objectives"][name]["compliance"],
+                                  6))
+            for name in objective_names:
+                slo_labeled(
+                    "slo_error_budget_remaining", name,
+                    round(slo_state["objectives"][name]["budget_remaining"],
+                          6))
+            for name in objective_names:
+                obj = slo_state["objectives"][name]
+                slo_labeled("slo_burn_rate", name,
+                            round(obj["burn_fast"], 6), ',window="fast"')
+                slo_labeled("slo_burn_rate", name,
+                            round(obj["burn_slow"], 6), ',window="slow"')
+        if recorder_stats is not None:
+            simple("recorder_events_total", recorder_stats.get("events", 0))
+            simple("recorder_events_dropped_total",
+                   recorder_stats.get("dropped", 0))
+            simple("recorder_dumps_total", recorder_stats.get("dumps", 0))
         if degraded_rung is not None:
             # read from the live supervisor at scrape time, like the queue
             # gauges — the metrics layer never mirrors ladder state
@@ -559,5 +851,42 @@ class ServeMetrics:
         if queued_tokens is not None:
             simple("queued_tokens", queued_tokens)
         for name, h in hists.items():
-            lines.extend(h.render(_PREFIX + name, _METRICS[name][1]))
-        return "\n".join(lines) + "\n"
+            lines.extend(h.render(_PREFIX + name, _METRICS[name][1],
+                                  bucket_exemplars.get(name)))
+        lines.extend(scrape_hist.render(
+            _PREFIX + "scrape_seconds", _METRICS["scrape_seconds"][1]
+        ))
+        if exemplars:
+            # OpenMetrics family naming: a counter family's HELP/TYPE
+            # metadata carries the name WITHOUT the _total suffix (samples
+            # keep it) — the classic 0.0.4 rendering above uses the full
+            # sample name, which a strict OM parser rejects, dropping the
+            # whole exposition. Rewrite metadata lines only. Counters whose
+            # OM family name cannot be expressed — no _total suffix, or a
+            # stripped name that collides with another registered family
+            # (queue_wait_seconds_total vs the queue_wait_seconds latency
+            # histogram) — are demoted to `unknown`, the OM escape hatch
+            # whose sample name equals its family name
+            om = []
+            for ln in lines:
+                if ln.startswith("# "):
+                    _hash, _, rest = ln.partition(" ")
+                    kind, _, rest = rest.partition(" ")
+                    name, _, tail = rest.partition(" ")
+                    base = name[len(_PREFIX):]
+                    if _METRICS.get(base, ("",))[0] == "counter":
+                        stripped = base[: -len("_total")]
+                        if base.endswith("_total") and stripped not in _METRICS:
+                            name = _PREFIX + stripped
+                        elif kind == "TYPE":
+                            tail = "unknown"
+                        ln = f"# {kind} {name} {tail}"
+                om.append(ln)
+            lines = om
+        out = "\n".join(lines) + "\n"
+        # self-observation AFTER the render: the cost just paid lands in
+        # the NEXT scrape's scrape_seconds (one short lock hold, no render
+        # work inside it)
+        with self._lock:
+            self._scrape_hist.observe(self._clock() - t_scrape)
+        return out

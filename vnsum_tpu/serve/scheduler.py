@@ -90,6 +90,7 @@ class MicroBatchScheduler:
         supervisor=None,
         journal=None,
         tenants=None,
+        recorder=None,
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -97,6 +98,12 @@ class MicroBatchScheduler:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.metrics = metrics or ServeMetrics()
+        # flight recorder (obs/recorder.py): None = no black box — the
+        # lifecycle paths then pay only `is None` checks (the bench A/B's
+        # all-off arm). With one, every typed transition appends a
+        # tuple-cheap event and anomalies (brownout entry, fatal failure,
+        # quarantine, SLO fast-burn, drain) snapshot the ring to disk
+        self.recorder = recorder
         # durability (serve/journal.py): None = volatile serving (the
         # pre-journal contract). With a RequestJournal, every admission
         # writes an ACCEPT record before any engine work and every outcome
@@ -272,10 +279,16 @@ class MicroBatchScheduler:
         try:
             self.queue.check_admission(est_tokens, tenant)
         except RequestShed as e:
-            self.metrics.observe_shed(e.reason)
+            self.metrics.observe_shed(e.reason, tenant=tenant)
             if e.reason is ShedReason.QUOTA:
                 self.metrics.observe_quota_shed(tenant or "default")
+            self._fr("shed", reason=e.reason.value, tenant=tenant)
             raise
+
+    def _fr(self, kind: str, rid: str = "", **fields) -> None:
+        """Flight-recorder append, free when no recorder is armed."""
+        if self.recorder is not None:
+            self.recorder.record(kind, rid, **fields)
 
     # -- cancellation -----------------------------------------------------
 
@@ -370,9 +383,10 @@ class MicroBatchScheduler:
         commit point had charged it), preempt-pin release, the typed
         CANCELLED ledger record, the owned-trace finalization, the stream
         close, and the future."""
-        self.metrics.observe_cancel(stage)
+        self.metrics.observe_cancel(stage, tenant=r.tenant)
         if reason == "disconnect":
             self.metrics.observe_cancel_disconnect()
+        self._fr("cancel", rid=r.trace_id, stage=stage, reason=reason)
         if self.tenants is not None and stage == "queued":
             # never dispatched: the admission bill buys nothing — return it
             # (queue-resident requests never charged DRR, so deficit credit
@@ -465,13 +479,15 @@ class MicroBatchScheduler:
         submit and, when durable serving is on, write the ACCEPT record —
         BEFORE the scheduler can take the request, so no engine work ever
         happens on an unjournaled request."""
-        self.metrics.observe_submit()
+        self.metrics.observe_submit(tenant=req.tenant)
         if self.tenants is not None:
             self.metrics.observe_tenant_request(req.tenant or "default")
         if req.stream is not None:
             self.metrics.observe_stream_request()
         if self.journal is not None:
             self.journal.accept(req)
+        self._fr("admit", rid=req.trace_id, tenant=req.tenant,
+                 tokens=req.est_tokens)
 
     def _journal_fail(self, req: ServeRequest, reason: str,
                       detail: str = "") -> None:
@@ -483,9 +499,11 @@ class MicroBatchScheduler:
             self.journal.fail(req.journal_rid, reason, detail)
 
     def _on_shed(self, req: ServeRequest, reason: ShedReason) -> None:
-        self.metrics.observe_shed(reason)
+        self.metrics.observe_shed(reason, tenant=req.tenant)
         if reason is ShedReason.QUOTA:
             self.metrics.observe_quota_shed(req.tenant or "default")
+        self._fr("shed", rid=req.trace_id, reason=reason.value,
+                 tenant=req.tenant)
         self._release_preempt_pins(req)
         self._journal_fail(req, f"shed:{reason.value}")
         # scheduler-owned traces must not leak open on the shed path; the
@@ -561,6 +579,12 @@ class MicroBatchScheduler:
             return
         head = batch[0]
         self._attempt_ctx = (time.monotonic(), 0.0, None)
+        if self.recorder is not None:
+            # guarded, not _fr: the riders list must not be built on the
+            # recorder-less hot path (the all-off arm's contract)
+            self.recorder.record("dispatch", rid=head.trace_id,
+                                 occupancy=len(batch),
+                                 rids=[r.trace_id for r in batch[1:]])
         if self.journal is not None:
             # START marks "engine work began" — replay after a crash here
             # recomputes from the ACCEPT payload (deterministic greedy), so
@@ -667,7 +691,8 @@ class MicroBatchScheduler:
                 rec.accepted_tokens = spec.accepted_tokens
                 rec.spec_steps = spec.verify_steps
             rec.cached_prompt_tokens = int(cached)
-            self.metrics.observe_request(rec)
+            self.metrics.observe_request(rec, tenant=r.tenant)
+            self._fr("complete", rid=r.trace_id, gen_tokens=n_out)
             self._trace_request(r, t0, engine_s, bt, "ok")
             self._release_preempt_pins(r)
             if r.stream is not None:
@@ -728,6 +753,8 @@ class MicroBatchScheduler:
         sup = self.supervisor
         cls = sup.classify(e)
         self.metrics.observe_failure(cls.value)
+        self._fr("fault", rid=group[0].trace_id, failure_class=cls.value,
+                 group=len(group))
         sup.note_failure(cls)
         self._apply_rung()
         if cls is FailureClass.FATAL:
@@ -739,6 +766,7 @@ class MicroBatchScheduler:
             # bisect so innocent riders escape through the clean half
             if len(group) == 1:
                 self.metrics.observe_quarantine()
+                self._dump("quarantine")
                 self._resolve_failed(group, e, cls)
             else:
                 self._bisect(group, work)
@@ -762,6 +790,7 @@ class MicroBatchScheduler:
                      else cls)
             if final is FailureClass.POISON:
                 self.metrics.observe_quarantine()
+                self._dump("quarantine")
             self._resolve_failed(group, e, final)
             return
         delay = sup.backoff_s(max(r.attempts for r in group))
@@ -785,6 +814,7 @@ class MicroBatchScheduler:
         independently; the culprit bottoms out alone and fails typed while
         every innocent rider escapes through a clean half."""
         self.metrics.observe_bisect()
+        self._fr("bisect", rid=group[0].trace_id, group=len(group))
         mid = len(group) // 2
         logger.warning(
             "bisecting crashing batch of %d to quarantine the fault",
@@ -795,11 +825,20 @@ class MicroBatchScheduler:
         work.append(group[mid:])
         work.append(group[:mid])
 
+    def _dump(self, reason: str) -> None:
+        """Anomaly-triggered flight-recorder dump (no-op without one)."""
+        if self.recorder is not None:
+            self.recorder.dump(reason)
+
     def _resolve_failed(self, group, e, failure_class) -> None:
         """Terminal typed failure: every rider's future gets RequestFailed
         carrying the class and the last underlying error."""
-        from .supervisor import RequestFailed
+        from .supervisor import FailureClass, RequestFailed
 
+        if failure_class is FailureClass.FATAL:
+            # the engine itself is gone: snapshot the black box while the
+            # lead-up is still in the ring
+            self._dump("fatal")
         t0, engine_s, bt = self._attempt_ctx
         exc = RequestFailed(failure_class, detail=str(e), cause=e)
         self._resolve_errored(group, exc, t0, engine_s, bt)
@@ -817,7 +856,9 @@ class MicroBatchScheduler:
         """Typed shed for a request already taken off the queue (deadline
         expiry at retry, drain overrun): metrics + owned-trace finalization
         + the future, mirroring the queue-side shed hook."""
-        self.metrics.observe_shed(reason)
+        self.metrics.observe_shed(reason, tenant=r.tenant)
+        self._fr("shed", rid=r.trace_id, reason=reason.value,
+                 tenant=r.tenant)
         self._release_preempt_pins(r)
         self._journal_fail(r, f"shed:{reason.value}")
         if r.own_trace and r.trace is not None and self.obs is not None:
@@ -860,6 +901,13 @@ class MicroBatchScheduler:
             "degradation ladder: rung %d -> %d (%s)",
             self._applied_rung, rung, "step-down" if down else "recovery",
         )
+        self._fr("rung_change", from_rung=self._applied_rung, to_rung=rung)
+        from .supervisor import Rung
+
+        if down and rung >= Rung.BROWNOUT:
+            # brownout entry is the post-mortem moment: dump the ring with
+            # the failure storm that drove the ladder down still in it
+            self._dump("brownout")
         self._applied_rung = rung
         toggle = getattr(self.backend, "set_prefix_cache_inserts", None)
         if callable(toggle):
@@ -873,7 +921,8 @@ class MicroBatchScheduler:
         )
         for r in batch:
             rec = self._record(r, "error", t0, engine_s, len(batch), 0, bt)
-            self.metrics.observe_request(rec)
+            self.metrics.observe_request(rec, tenant=r.tenant)
+            self._fr("failed", rid=r.trace_id, reason=reason)
             self._trace_request(r, t0, engine_s, bt, "error")
             self._release_preempt_pins(r)
             self._journal_fail(r, reason, str(e))

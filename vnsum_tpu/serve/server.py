@@ -45,7 +45,20 @@ Endpoints:
                         expires; within it, a reconnect with Last-Event-ID
                         resumes via one full-text snapshot event
     GET /metrics        Prometheus text (serve/metrics.py): counters plus
-                        queue-wait/TTFT/e2e/occupancy/spec histograms
+                        queue-wait/TTFT/e2e/occupancy/spec histograms;
+                        with --slo also the vnsum_serve_slo_* burn-rate
+                        gauges, per-tenant usage series, and OpenMetrics-
+                        style trace_id exemplars on the latency buckets
+    GET /v1/usage       per-tenant usage ledger (serve/usage.py): token/
+                        outcome counters + windowed latency quantiles;
+                        ?tenant= filters one tenant
+    GET /debug/slo      SLO engine detail (--slo, serve/slo.py): per-
+                        objective compliance, fast/slow burn rates, error
+                        budget remaining, breach state, exemplar trace ids
+    GET /debug/flightrecorder
+                        the flight recorder's typed-event ring
+                        (obs/recorder.py); anomalies also dump it to
+                        --flight-dir
     GET /debug/trace    Chrome trace-event JSON of the recent-request ring
                         (vnsum_tpu.obs) — load in ui.perfetto.dev; one track
                         per request, one per engine batch. ?save=1 also
@@ -117,8 +130,21 @@ class ServeState:
         tenants=None,
         stream_heartbeat_s: float = 15.0,
         stream_idle_timeout_s: float = 10.0,
+        slo: str | None = None,
+        slo_fast_s: float = 60.0,
+        slo_slow_s: float = 600.0,
+        slo_burn_fast: float = 10.0,
+        slo_burn_slow: float = 1.0,
+        flight_dir: str | None = None,
+        flight_events: int = 4096,
+        flight_recorder: bool = True,
+        windowed_metrics: bool = True,
     ) -> None:
         self.backend = backend
+        # uptime anchors for /healthz (monotonic for the math, wall clock
+        # for the human-readable start stamp)
+        self.started_monotonic = time.monotonic()
+        self.started_wall = time.time()
         # stream hardening (serve/stream.py): SSE keepalive cadence (0 =
         # no heartbeats) and the bounded resume window — a streaming
         # request whose consumer disconnected and never reattached within
@@ -179,16 +205,42 @@ class ServeState:
             # device_profile() call in this process now lands its XLA trace
             # next to the Chrome dumps written here
             os.environ.setdefault("VNSUM_PROFILE_DIR", trace_dir)
+        # production observability (this PR's tentpole): rolling-window
+        # metrics + per-tenant usage ledger (serve/metrics.py over
+        # obs/window.py), the flight recorder (obs/recorder.py), and the
+        # SLO engine (serve/slo.py). windowed_metrics=False /
+        # flight_recorder=False are the bench A/B's all-off levers — never
+        # operator flags (always-on is the serving contract)
+        from .metrics import ServeMetrics
+
+        self.metrics = ServeMetrics(
+            windowed=windowed_metrics,
+            horizon_s=max(slo_slow_s, 2 * slo_fast_s),
+            sub_windows=60,
+        )
+        self.metrics.usage_window_s = slo_fast_s
+        if tenants is not None:
+            # declared tenants get their labels ahead of any traffic: a
+            # hostile name burst can never evict a table tenant's series
+            self.metrics.seed_tenants(tenants.stats().keys())
+        from ..obs.recorder import FlightRecorder
+
+        self.recorder = (
+            FlightRecorder(capacity=flight_events, directory=flight_dir)
+            if flight_recorder else None
+        )
         common = dict(
             max_batch=max_batch,
             max_wait_s=max_wait_s,
             max_queue_depth=max_queue_depth,
             max_queued_tokens=max_queued_tokens,
+            metrics=self.metrics,
             obs=self.obs,
             trace_dir=trace_dir,
             supervisor=supervisor,
             journal=self.journal,
             tenants=tenants,
+            recorder=self.recorder,
         )
         if inflight:
             # in-flight batching (serve/inflight.py): slot-feeding over the
@@ -206,6 +258,22 @@ class ServeState:
             # arm the scheduler's idle-consumer sweep: abandoned streams
             # (disconnect, no resume) cancel after this window
             self.scheduler.stream_idle_timeout_s = self.stream_idle_timeout_s
+        # SLO engine (--slo): declarative objectives judged over the
+        # rolling windows; sustained fast burn fires the flight recorder.
+        # Surfaced (healthz/metrics/debug), never coupled into the ladder
+        self.slo = None
+        if slo:
+            from .slo import SloEngine, parse_slo_spec
+
+            self.slo = SloEngine(
+                parse_slo_spec(slo) if isinstance(slo, str) else slo,
+                self.metrics,
+                fast_window_s=slo_fast_s,
+                slow_window_s=slo_slow_s,
+                breach_fast_burn=slo_burn_fast,
+                breach_slow_burn=slo_burn_slow,
+                recorder=self.recorder,
+            )
         self.default_deadline_s = default_deadline_s
         self._strategies: dict[str, object] = {}
         import threading
@@ -312,6 +380,9 @@ class ServeState:
                 continue
             n += 1
         self.journal.note_replay(n, time.monotonic() - t0)
+        if self.recorder is not None:
+            self.recorder.record("journal_replay", replayed=n,
+                                 seconds=round(time.monotonic() - t0, 6))
         if n:
             logger.info("journal replay: re-enqueued %d request(s)", n)
         return n
@@ -357,6 +428,8 @@ class ServeState:
         return payload
 
     def close(self, drain_timeout_s: float = 30.0) -> None:
+        if self.slo is not None:
+            self.slo.close()
         self.scheduler.close(drain=True, timeout=drain_timeout_s)
         if self.journal is not None:
             # drain first so every completion is journaled, then mark the
@@ -364,6 +437,10 @@ class ServeState:
             # so the seal is honest either way
             self.journal.seal()
             self.journal.close()
+        if self.recorder is not None:
+            # SIGTERM-drain dump: the recorder's last act — the full drain
+            # (including any overrun sheds) is in the ring it writes out
+            self.recorder.dump("drain")
 
 
 class _BadRequest(ValueError):
@@ -475,10 +552,12 @@ def make_handler(state: ServeState):
             headers = {"Retry-After": str(max(1, int(round(retry_after))))}
             self._json(payload, status, headers)
 
-        def _text(self, body: str, status: int = 200) -> None:
+        def _text(self, body: str, status: int = 200,
+                  content_type: str = "text/plain; version=0.0.4; "
+                                      "charset=utf-8") -> None:
             raw = body.encode()
             self.send_response(status)
-            self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(raw)))
             self.end_headers()
             self.wfile.write(raw)
@@ -505,17 +584,44 @@ def make_handler(state: ServeState):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif path == "/debug/slo":
+                if state.slo is None:
+                    self._json({"error": "no SLOs configured (--slo unset)"},
+                               404)
+                    return
+                self._json(state.slo.debug_payload())
+            elif path == "/debug/flightrecorder":
+                if state.recorder is None:
+                    self._json({"error": "flight recorder disabled"}, 404)
+                    return
+                self._json(state.recorder.snapshot())
+            elif path == "/v1/usage":
+                self._usage(query)
             elif path.startswith("/v1/requests/"):
                 self._request_status(path[len("/v1/requests/"):])
             elif path == "/healthz":
                 sup = state.supervisor
+                from .. import __version__
+
                 payload = {
                     "status": "ok",
                     "backend": state.backend.name,
+                    "version": __version__,
+                    "started_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ",
+                        time.gmtime(state.started_wall),
+                    ),
+                    "uptime_s": round(
+                        time.monotonic() - state.started_monotonic, 3
+                    ),
                     "queue_depth": state.scheduler.queue.depth,
                     "queued_tokens": state.scheduler.queue.queued_tokens,
                     "closed": state.scheduler.closed,
                 }
+                if state.slo is not None:
+                    # the one-line SLO verdict: probes and humans read the
+                    # same judgement the gauges and /debug/slo render
+                    payload["slo"] = state.slo.status_line()
                 mesh_state = state.mesh_state()
                 if mesh_state is not None:
                     # echo the serving mesh so probes/load balancers can
@@ -553,8 +659,15 @@ def make_handler(state: ServeState):
                     mesh_state["replica_occupancy"] = (
                         slot_state[1] / mesh_state["data"]
                     )
-                self._text(
-                    state.scheduler.metrics.render_prometheus(
+                # exemplars only for scrapers that NEGOTIATE OpenMetrics:
+                # the classic text-format parser (the default Prometheus
+                # Accept) rejects the trailing `# {...}` after a sample
+                # and would drop the entire scrape
+                openmetrics = (
+                    "application/openmetrics-text"
+                    in (self.headers.get("Accept") or "")
+                )
+                body = state.scheduler.metrics.render_prometheus(
                         queue_depth=state.scheduler.queue.depth,
                         queued_tokens=state.scheduler.queue.queued_tokens,
                         cache_stats=cache_stats,
@@ -572,10 +685,67 @@ def make_handler(state: ServeState):
                             state.tenants.stats()
                             if state.tenants is not None else None
                         ),
+                        slo_state=(
+                            state.slo.export_state()
+                            if state.slo is not None else None
+                        ),
+                        recorder_stats=(
+                            state.recorder.stats_dict()
+                            if state.recorder is not None else None
+                        ),
+                        exemplars=openmetrics,
                     )
-                )
+                if openmetrics:
+                    # the OpenMetrics exposition requires the EOF marker
+                    self._text(
+                        body + "# EOF\n",
+                        content_type="application/openmetrics-text; "
+                                     "version=1.0.0; charset=utf-8",
+                    )
+                else:
+                    self._text(body)
             else:
                 self._json({"error": "not found"}, 404)
+
+        def _usage(self, query: str) -> None:
+            """``GET /v1/usage[?tenant=]`` — the per-tenant usage ledger:
+            monotonic token/outcome counters plus windowed latency
+            quantiles per tenant (serve/usage.py). 404s when the metrics
+            were built without rolling windows, or for a tenant the ledger
+            has never seen."""
+            import urllib.parse
+
+            from .usage import TenantLabelRegistry
+
+            usage = state.metrics.usage_snapshot(
+                state.metrics.usage_window_s
+            )
+            if usage is None:
+                self._json(
+                    {"error": "usage accounting disabled "
+                              "(windowed metrics off)"}, 404,
+                )
+                return
+            q = urllib.parse.parse_qs(query)
+            tenant = q.get("tenant", [None])[0]
+            payload = {
+                "window_s": state.metrics.usage_window_s,
+                "tenants": usage,
+            }
+            if tenant is not None:
+                # ledger rows are keyed by SANITIZED names ('team a' was
+                # accounted as 'team_a') — map the query the same way, but
+                # never through canonical(): a read must not grow the
+                # registry or charge its overflow counter
+                tenant = TenantLabelRegistry.sanitize(tenant)
+                if tenant not in usage:
+                    self._json(
+                        {"error": f"no usage recorded for tenant "
+                                  f"{tenant!r}"}, 404,
+                    )
+                    return
+                payload["tenants"] = {tenant: usage[tenant]}
+            self._json(payload)
 
         def _request_status(self, raw_rid: str) -> None:
             """``GET /v1/requests/<id>`` — the reconnect-and-poll surface
@@ -1424,6 +1594,35 @@ def main(argv: list[str] | None = None) -> int:
                         "reattach) for this long is CANCELLED and its slot "
                         "reclaimed (0 = cancel immediately on disconnect, "
                         "no resume window)")
+    p.add_argument("--slo", default=None,
+                   help="declarative SLOs over rolling windows "
+                        "(serve/slo.py): comma-separated name=value "
+                        "objectives, e.g. 'ttft_p99=0.5,e2e_p99=30,"
+                        "error_rate=0.01,availability=0.999'. Evaluated "
+                        "with fast/slow burn rates; breaches render on "
+                        "/healthz, /debug/slo, and the vnsum_serve_slo_* "
+                        "gauges, and fire the flight recorder")
+    p.add_argument("--slo-fast-s", type=float, default=60.0,
+                   help="SLO fast burn window (also the window of the "
+                        "per-tenant usage latency gauges)")
+    p.add_argument("--slo-slow-s", type=float, default=600.0,
+                   help="SLO slow burn window (also the rolling-metrics "
+                        "horizon)")
+    p.add_argument("--slo-burn-fast", type=float, default=10.0,
+                   help="fast-window burn rate at/above which an objective "
+                        "breaches (with the slow threshold also met)")
+    p.add_argument("--slo-burn-slow", type=float, default=1.0,
+                   help="slow-window burn rate the fast breach must be "
+                        "sustained at (multi-window alert discipline)")
+    p.add_argument("--flight-dir", default=None,
+                   help="flight recorder (obs/recorder.py) dump directory: "
+                        "anomalies (brownout entry, fatal failure, poison "
+                        "quarantine, SLO fast-burn, SIGTERM drain) write "
+                        "the typed-event ring here as "
+                        "flight_<reason>_<utc-ms>_<n>.json. Unset = ring + "
+                        "/debug/flightrecorder only, no dumps")
+    p.add_argument("--flight-events", type=int, default=4096,
+                   help="flight-recorder ring capacity (events)")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="graceful-shutdown drain budget before queued and "
                         "in-flight requests are shed typed")
@@ -1503,6 +1702,22 @@ def main(argv: list[str] | None = None) -> int:
         except ValueError as e:
             p.error(f"--tenants {args.tenants!r}: {e}")
 
+    if args.slo:
+        from .slo import parse_slo_spec
+
+        try:
+            parse_slo_spec(args.slo)  # validate at the CLI boundary
+        # lint-allow[swallowed-exception]: p.error raises SystemExit(2) — the CLI-error path, nothing to resolve
+        except ValueError as e:
+            p.error(f"--slo {args.slo!r}: {e}")
+        if args.slo_fast_s >= args.slo_slow_s:
+            # the engine would raise the same complaint inside ServeState
+            # construction — surface it as a clean CLI error instead
+            p.error(
+                f"--slo-fast-s {args.slo_fast_s} must be shorter than "
+                f"--slo-slow-s {args.slo_slow_s}"
+            )
+
     supervisor = None
     if not args.no_supervise:
         from .supervisor import EngineSupervisor, RetryPolicy
@@ -1536,6 +1751,13 @@ def main(argv: list[str] | None = None) -> int:
         tenants=tenants,
         stream_heartbeat_s=args.stream_heartbeat_s,
         stream_idle_timeout_s=args.stream_idle_timeout_s,
+        slo=args.slo,
+        slo_fast_s=args.slo_fast_s,
+        slo_slow_s=args.slo_slow_s,
+        slo_burn_fast=args.slo_burn_fast,
+        slo_burn_slow=args.slo_burn_slow,
+        flight_dir=args.flight_dir,
+        flight_events=args.flight_events,
     )
     if args.inflight:
         state.scheduler.preempt_budget = max(args.preempt_budget, 1)
