@@ -1013,7 +1013,7 @@ def slo_phase(args) -> dict:
     recorder, and a four-objective SLO engine — against a build with all
     of it constructed away (trace_sample=0, windowed_metrics=False,
     flight_recorder=False, no --slo). The goodput delta IS the layer's
-    cost; <2% is the acceptance bar, same as the journal's. Best-of-3 per
+    cost; <2% is the acceptance bar, same as the journal's. Best-of-5 per
     arm with the reps INTERLEAVED (on, off, on, off, ...): the in-flight
     shape at ~100 rps jitters +/-2% run to run on this host — the same
     order as the bar — and host drift across a multi-minute bench
@@ -1049,7 +1049,11 @@ def slo_phase(args) -> dict:
     }
     arms = {}
     surfaces = {}
-    for _rep in range(3):
+    # best-of-5 interleaved (was 3): across full-bench reruns the 3-rep
+    # best swung this measurement from -6.9% to +2.4% on this shared host
+    # — more than the 2% bar in both directions — so the bar was judging
+    # rep luck, not the layer; two extra reps per arm converge the bests
+    for _rep in range(5):
         for name, spec in specs.items():
             state = ServeState(
                 FakeBackend(**backend_kw),
@@ -1108,11 +1112,107 @@ def slo_phase(args) -> dict:
                     "shape, identical load both arms; obs_on = tracing + "
                     "rolling windows + usage ledger + flight recorder + "
                     "4-objective SLO engine, obs_off = all constructed "
-                    "away; best-of-3 per arm, reps interleaved",
+                    "away; best-of-5 per arm, reps interleaved",
         "slo_spec": specs["obs_on"]["slo"],
         **arms,
         "surfaces": surfaces,
         "slo_overhead_pct": overhead_pct,
+    }
+
+
+def watchdog_phase(args) -> dict:
+    """Watchdog overhead A/B (ISSUE 15 tentpole): the r04 mixed in-flight
+    closed loop with liveness fully armed — heartbeat registry beaten from
+    the queue's wait loops, a dispatch ticket (begin/end + token-derived
+    budget) around every slot admit and decode segment, and the 10Hz
+    monitor thread — against a build with the watchdog constructed away
+    (watchdog=False). The goodput delta IS the healthy-path cost of the
+    bounded-dispatch bookkeeping; <1% is the acceptance bar (tighter than
+    the journal/SLO layers' 2%: this is per-SEGMENT arithmetic, not I/O).
+    Best-of-5 per arm, reps interleaved, same drift rationale as the slo
+    phase. The armed arm also certifies the surfaces: /healthz must carry
+    the watchdog line with a live scheduler heartbeat, and the healthy
+    path must finish with ZERO stalls — a false positive under clean load
+    would be a recovery storm in production."""
+    short = "tin ngan gon sau day chi tam tu"
+    long_ = "phan tich chuyen sau ve tinh hinh kinh te xa hoi " * 6
+
+    def payload(cid, i):
+        return {"prompt": short if (cid + i) % 2 else long_,
+                "deadline_ms": args.deadline_s * 1000}
+
+    backend_kw = dict(
+        batch_overhead_s=args.inflight_prefill_s,
+        per_step_s=args.per_step_s,
+        segment_words=args.segment_words,
+        segment_overhead_s=args.segment_overhead_s,
+        per_slot_segment_s=args.per_slot_segment_s,
+    )
+    specs = {
+        "watchdog_on": dict(watchdog=True, watchdog_interval_s=0.1),
+        "watchdog_off": dict(watchdog=False),
+    }
+    arms = {}
+    surfaces = {}
+    # best-of-5 (vs the slo phase's 3): the expected effect here is ~0.1%
+    # — far BELOW this host's ±2% rep jitter — so the bar is really "the
+    # best reps of both arms converge"; two extra reps per arm tighten
+    # that materially for ~20s of bench time
+    for _rep in range(5):
+        for name, spec in specs.items():
+            state = ServeState(
+                FakeBackend(**backend_kw),
+                max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1000.0,
+                max_queue_depth=64,
+                trace_sample=0.0,
+                inflight=True, slots=args.max_batch,
+                **spec,
+            )
+            server = make_server(state, "127.0.0.1", 0)
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            loop = closed_loop(
+                base, args.clients, args.per_client, args.deadline_s,
+                payload,
+            )
+            if name == "watchdog_on" and not surfaces:
+                u = urllib.parse.urlparse(base)
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=10)
+                conn.request("GET", "/healthz")
+                health = json.loads(conn.getresponse().read())
+                conn.close()
+                wd = state.watchdog.stats_dict()
+                surfaces = {
+                    "healthz_watchdog": health.get("watchdog"),
+                    "stalls": sum(wd["stalls"].values()),
+                    "hung_dispatches": wd["hung_dispatches"],
+                    "heartbeats": sorted(wd["heartbeat_ages"]),
+                }
+            server.shutdown()
+            server.server_close()
+            state.close()
+            best = arms.get(name)
+            if best is None or loop["goodput_rps"] > best["goodput_rps"]:
+                arms[name] = loop
+    on, off = arms["watchdog_on"], arms["watchdog_off"]
+    overhead_pct = (
+        round((off["goodput_rps"] - on["goodput_rps"])
+              / off["goodput_rps"] * 100.0, 2)
+        if off["goodput_rps"] else 0.0
+    )
+    return {
+        "workload": f"{args.clients} closed-loop clients x "
+                    f"{args.per_client} requests, r04 mixed in-flight "
+                    "shape, identical load both arms; watchdog_on = "
+                    "heartbeats + per-dispatch budget tickets + 10Hz "
+                    "monitor, watchdog_off = constructed away; best-of-5 "
+                    "per arm, reps interleaved",
+        **arms,
+        "surfaces": surfaces,
+        "watchdog_overhead_pct": overhead_pct,
     }
 
 
@@ -1200,7 +1300,12 @@ def main(argv=None) -> int:
                         "recorder arm costs more than this percentage of "
                         "goodput vs the all-off arm (CI smoke passes a "
                         "softer floor for shared-runner jitter)")
-    p.add_argument("--out", default="BENCH_serving_r09.json")
+    p.add_argument("--watchdog-max-overhead-pct", type=float, default=1.0,
+                   help="exit non-zero when the watchdog-armed arm costs "
+                        "more than this percentage of goodput vs the "
+                        "watchdog-less arm (CI smoke passes a softer floor "
+                        "for shared-runner jitter)")
+    p.add_argument("--out", default="BENCH_serving_r10.json")
     p.add_argument("--min-speedup", type=float, default=4.0,
                    help="exit non-zero below this goodput ratio (CI smoke "
                         "passes a softer floor: shared 2-core runners get "
@@ -1338,6 +1443,10 @@ def main(argv=None) -> int:
     print("slo phase ...", flush=True)
     slo = slo_phase(args)
 
+    # 12) liveness: watchdog heartbeat + dispatch-budget bookkeeping on/off
+    print("watchdog phase ...", flush=True)
+    watchdog = watchdog_phase(args)
+
     speedup = (
         serve_closed["goodput_rps"] / serial_closed["goodput_rps"]
         if serial_closed["goodput_rps"]
@@ -1379,6 +1488,7 @@ def main(argv=None) -> int:
         "qos": qos,
         "cancel": cancel,
         "slo": slo,
+        "watchdog": watchdog,
         "serving_stats": stats.to_dict(),
         # server-side histogram snapshots (vnsum_tpu.obs): bucket counts
         # plus bucket-derived p50/p95/p99 for queue wait, TTFT, e2e latency,
@@ -1449,6 +1559,13 @@ def main(argv=None) -> int:
         f"{slo['surfaces']['usage_requests']} requests in the usage "
         f"ledger, {slo['surfaces']['recorder_events']} recorder events)"
     )
+    print(
+        f"watchdog: healthy-path overhead {watchdog['watchdog_overhead_pct']}% "
+        f"({watchdog['watchdog_on']['goodput_rps']} vs "
+        f"{watchdog['watchdog_off']['goodput_rps']} rps; "
+        f"{watchdog['surfaces']['stalls']} stalls, heartbeats "
+        f"{watchdog['surfaces']['heartbeats']})"
+    )
     print(f"wrote {args.out}")
     ok = (
         speedup >= args.min_speedup
@@ -1479,6 +1596,13 @@ def main(argv=None) -> int:
         and slo["surfaces"]["slo_objectives"] == 4
         and slo["surfaces"]["usage_requests"] > 0
         and slo["surfaces"]["recorder_events"] > 0
+        # watchdog bookkeeping stays inside the healthy-path bar, the armed
+        # arm's surfaces were live (heartbeat registered, /healthz line),
+        # and clean load produced ZERO stalls (false-positive immunity)
+        and watchdog["watchdog_overhead_pct"] <= args.watchdog_max_overhead_pct
+        and watchdog["surfaces"]["stalls"] == 0
+        and "scheduler" in watchdog["surfaces"]["heartbeats"]
+        and watchdog["surfaces"]["healthz_watchdog"] is not None
     )
     return 0 if ok else 1
 

@@ -562,6 +562,295 @@ def churn_soak(args) -> int:
     return 0 if ok else 1
 
 
+# -- hang-injection soak (--hang): wedged threads, no exceptions -------------
+
+
+def hang_soak(args) -> int:
+    """Hang-injection soak (ISSUE 15): the process never crashes and no
+    exception ever fires — threads simply STOP RETURNING, at seeded points,
+    and the watchdog must keep the service live end to end:
+
+    - epoch 1 (``mid_dispatch``): a forever-hang inside a one-shot engine
+      dispatch. The watchdog declares it HUNG past its budget, resolves the
+      riders typed (clients retry), replaces the scheduler thread, and the
+      server keeps serving — graceful SIGTERM must still exit 0.
+    - epoch 2 (``mid_slot_loop``): a forever-hang inside an in-flight decode
+      segment. Recovery tears the loop down and REQUEUES every resident
+      through the journal's replayable ACCEPT — clients see nothing but
+      latency; byte-identity holds on the rebuilt loop.
+    - epoch 3 (``mid_fsync``): a forever-hang inside the journal's
+      group-commit fsync — the scheduler wedges INSIDE the journal lock,
+      where a replacement thread would deadlock too. The watchdog
+      classifies it as a lock stall and escalates: supervised
+      seal-and-exit with WATCHDOG_EXIT_CODE, the harness restarts (the
+      process-manager role), and journal replay restores state.
+    - final epoch: no faults; the ledger quiesces and seals.
+
+    Offline audit: every journaled ACCEPT terminal (0 lost), COMPLETEs
+    byte-identical to the deterministic reference, watchdog stack dumps on
+    disk for BOTH the dispatch and the lock stalls (with the wedged frame —
+    the fault plan's hang site — visible in a stack), a flight-recorder
+    dump carrying the typed ``stall`` event, and every stall detected
+    within its configured bound + ``--detect-slack-s``."""
+    from vnsum_tpu.serve.watchdog import WATCHDOG_EXIT_CODE
+
+    journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-hang-")
+    own_dir = args.journal_dir is None
+    flight_dir = str(Path(journal_dir) / "flight")
+    common = [
+        "--max-batch", "4",
+        "--max-wait-ms", "20",
+        "--drain-timeout-s", "20",
+        "--trace-sample", "0",
+        "--fake-batch-overhead-ms", "40",
+        "--fake-per-prompt-ms", "2",
+        "--flight-dir", flight_dir,
+        # tight liveness bounds so the soak runs in seconds: dispatches get
+        # a 1s budget (per-token term off for determinism), loop heartbeats
+        # a 1s deadline, the monitor ticks at 10Hz
+        "--watchdog-interval-s", "0.1",
+        "--watchdog-stall-s", "1.0",
+        "--watchdog-dispatch-budget-s", "1.0",
+        "--watchdog-dispatch-per-token-ms", "0",
+    ]
+    inflight = [
+        "--inflight", "--slots", "4",
+        "--fake-segment-overhead-ms", "20",
+        "--fake-segment-words", "2",
+    ]
+    s = args.seed
+    epochs = [
+        # (name, extra server args, VNSUM_FAULTS, expected stall kind, end)
+        ("mid_dispatch", [],
+         f"seed={s};fake.dispatch:hang@on_call=4,delay_s=0",
+         "dispatch", "sigterm"),
+        ("mid_slot_loop", inflight,
+         f"seed={s};fake.slot_step:hang@on_call=6,delay_s=0",
+         "dispatch", "sigterm"),
+        ("mid_fsync", ["--journal-fsync-ms", "0"],
+         f"seed={s};journal.fsync:hang@on_call=3,delay_s=0",
+         "lock", "escalate"),
+    ]
+    port = free_port()
+    driver = LoadDriver(port, args.clients, args.per_client * 10)
+    epoch_counters: list[dict] = []
+    escalate_rc: int | None = None
+    srv = None
+
+    def scrape_stalls(kind: str):
+        return scrape_metric(
+            port, f'vnsum_serve_watchdog_stalls_total{{kind="{kind}"}}'
+        )
+
+    try:
+        driver_started = False
+        for name, extra, faults, expect_kind, end in epochs:
+            print(f"[epoch {name}] faults={faults}", flush=True)
+            srv = ServerProcess(
+                port, journal_dir=journal_dir, extra_args=common + extra,
+                env={"VNSUM_FAULTS": faults},
+            )
+            srv.start()
+            srv.wait_healthy()
+            if not driver_started:
+                driver.start()
+                driver_started = True
+            if end == "sigterm":
+                # in-process recovery epoch: wait for the stall verdict AND
+                # a completed recovery, settle, then prove the server is
+                # still a working server (graceful drain, exit 0)
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    stalls = scrape_stalls(expect_kind)
+                    recoveries = scrape_metric(
+                        port, "vnsum_serve_watchdog_recoveries_total"
+                    )
+                    if (stalls or 0) > 0 and (recoveries or 0) > 0:
+                        break
+                    time.sleep(0.1)
+                else:
+                    print(f"FAIL: epoch {name}: no {expect_kind} stall/"
+                          "recovery observed")
+                    return 1
+                time.sleep(1.0)  # let retried/requeued work flow
+                epoch_counters.append({
+                    "epoch": name,
+                    "stalls_dispatch": scrape_stalls("dispatch"),
+                    "stalls_lock": scrape_stalls("lock"),
+                    "recoveries": scrape_metric(
+                        port, "vnsum_serve_watchdog_recoveries_total"),
+                    "hung_dispatches": scrape_metric(
+                        port, "vnsum_serve_watchdog_hung_dispatches_total"),
+                })
+                srv.sigterm()
+                rc = srv.wait_exit(timeout_s=30)
+                if rc != 0:
+                    print(f"FAIL: epoch {name}: graceful SIGTERM exited "
+                          f"{rc}, not 0")
+                    return 1
+                srv = None
+            else:
+                # escalation epoch: the wedge is inside the journal lock —
+                # the only liveness-preserving exit is seal-and-exit with
+                # the watchdog code; the harness is the process manager
+                rc = srv.wait_exit(timeout_s=60)
+                escalate_rc = rc
+                if rc != WATCHDOG_EXIT_CODE:
+                    print(f"FAIL: epoch {name}: expected watchdog exit "
+                          f"{WATCHDOG_EXIT_CODE}, got {rc}")
+                    return 1
+                epoch_counters.append({"epoch": name, "exit_code": rc})
+                srv = None
+
+        # final epoch: no faults — replay the escalation epoch's unfinished
+        # work, quiesce, and seal
+        print("[epoch final] no faults: replay + quiesce + seal", flush=True)
+        srv = ServerProcess(port, journal_dir=journal_dir,
+                            extra_args=common, env={"VNSUM_FAULTS": ""})
+        srv.start()
+        srv.wait_healthy()
+        # the manual twin: SIGUSR1 must write an on-demand stack dump to
+        # --flight-dir (audited below alongside the automatic ones)
+        import os as _os
+        import signal as _signal
+
+        _os.kill(srv.proc.pid, _signal.SIGUSR1)
+        driver.stop(timeout_s=30)
+        t_end = time.monotonic() + args.quiesce_timeout_s
+        while time.monotonic() < t_end:
+            if scrape_metric(port, "vnsum_serve_journal_pending") == 0:
+                break
+            time.sleep(0.2)
+        pending = scrape_metric(port, "vnsum_serve_journal_pending")
+        if pending != 0:
+            print(f"FAIL: journal never quiesced (pending={pending})")
+            return 1
+        srv.sigterm()
+        rc = srv.wait_exit(timeout_s=30)
+        if rc != 0:
+            print(f"FAIL: final graceful SIGTERM exited {rc}, not 0")
+            return 1
+        srv = None
+    finally:
+        driver.stop(timeout_s=5)
+        if srv is not None and srv.alive:
+            srv.sigkill()
+
+    # -- offline audit (read-only) ----------------------------------------
+    entries, sealed, torn = RequestJournal.read_state(journal_dir)
+    lost = [e.rid for e in entries.values() if not e.terminal]
+    completed = [e for e in entries.values() if e.status == "complete"]
+    hung_failed = [e for e in entries.values()
+                   if e.status == "failed" and e.reason == "hung"]
+    mismatches = [
+        e.rid for e in completed if e.text != reference_output(e.payload)
+    ]
+
+    # watchdog stack dumps: both classifications on disk, the wedged frame
+    # (the fault plan's hang site) visible in a stack, detection latency
+    # inside the configured bound
+    wd_dumps = sorted(
+        p for p in Path(flight_dir).glob("watchdog_*.json")
+        if not p.name.startswith("watchdog_sigusr1_")  # audited separately
+    )
+    dump_kinds: dict[str, int] = {}
+    detect_latencies: list[float] = []
+    stacks_show_wedge = False
+    dumps_well_formed = bool(wd_dumps)
+    for p in wd_dumps:
+        try:
+            d = json.loads(p.read_text())
+            stall = d["stall"]
+            dump_kinds[stall["kind"]] = dump_kinds.get(stall["kind"], 0) + 1
+            detect_latencies.append(
+                round(stall["stalled_for_s"] - stall["limit_s"], 3)
+            )
+            if not d["stacks"]:
+                raise ValueError("dump carries no thread stacks")
+            if any("faults.py" in ln or "_hang_release" in ln
+                   for t in d["stacks"] for ln in t["stack"]):
+                stacks_show_wedge = True
+        except (KeyError, ValueError):
+            dumps_well_formed = False
+    # SIGUSR1's manual stack dump (written by the final, healthy epoch)
+    sigusr1_dumps = sorted(Path(flight_dir).glob("watchdog_sigusr1_*.json"))
+    sigusr1_ok = False
+    for p in sigusr1_dumps:
+        try:
+            d = json.loads(p.read_text())
+            sigusr1_ok = bool(d["stacks"])
+        except (KeyError, ValueError):
+            pass
+    # flight-recorder ring dumps carrying the typed stall event
+    stall_events = 0
+    for p in sorted(Path(flight_dir).glob("flight_*.json")):
+        try:
+            d = json.loads(p.read_text())
+            stall_events += sum(
+                1 for e in d.get("events", []) if e.get("kind") == "stall"
+            )
+        except ValueError:
+            dumps_well_formed = False
+
+    record = {
+        "bench": "chaos_soak_hang_injection",
+        "seed": args.seed,
+        "epochs": epoch_counters,
+        "escalation_exit_code": escalate_rc,
+        "sealed": sealed,
+        "torn_records_dropped": torn,
+        "journaled_accepts": len(entries),
+        "completed": len(completed),
+        "typed_failed_hung": len(hung_failed),
+        "typed_failed": sum(
+            1 for e in entries.values() if e.status == "failed"
+        ),
+        "lost": lost,
+        "replay_byte_mismatches": mismatches,
+        "watchdog_dumps": {
+            "files": len(wd_dumps),
+            "by_kind": dump_kinds,
+            "detect_latencies_s": detect_latencies,
+            "stacks_show_wedged_frame": stacks_show_wedge,
+            "well_formed": dumps_well_formed,
+        },
+        "flight_stall_events": stall_events,
+        "sigusr1_dump_ok": sigusr1_ok,
+        "detect_slack_s": args.detect_slack_s,
+        "client_attempted": len(driver.attempted),
+        "client_saw_200": len(driver.completed),
+    }
+    print(json.dumps(record, indent=2, ensure_ascii=False))
+    if args.out:
+        atomic_write_json(args.out, record)
+        print(f"wrote {args.out}")
+    if own_dir:
+        shutil.rmtree(journal_dir, ignore_errors=True)
+
+    ok = (
+        not lost
+        and not mismatches
+        and sealed
+        and len(entries) > 0
+        and dumps_well_formed
+        # both stall classes actually exercised, stacks on the tape, and
+        # the typed stall event in a flight dump
+        and dump_kinds.get("dispatch", 0) >= 2  # one per in-process epoch
+        and dump_kinds.get("lock", 0) >= 1
+        and stacks_show_wedge
+        and stall_events > 0
+        and sigusr1_ok
+        # the escalation epoch exited with the supervised watchdog code
+        and escalate_rc == WATCHDOG_EXIT_CODE
+        # detection bound: each stall declared within (limit + slack) —
+        # the monitor interval is 0.1s, so the slack is host-scheduling
+        # headroom, not a loophole
+        and all(lat <= args.detect_slack_s for lat in detect_latencies)
+    )
+    print("hang-soak liveness invariant:", "OK" if ok else "VIOLATED")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--seed", type=int, default=7)
@@ -599,12 +888,28 @@ def main(argv=None) -> int:
     p.add_argument("--stream-idle-timeout-s", type=float, default=0.4,
                    help="churn mode: the server's bounded resume window "
                         "(abandoned streams cancel after this)")
+    p.add_argument("--hang", action="store_true",
+                   help="hang-injection soak (serve/watchdog.py): seeded "
+                        "forever-hangs mid-dispatch (one-shot), "
+                        "mid-slot-loop (in-flight), and mid-fsync (inside "
+                        "the journal lock). Proves liveness end to end: "
+                        "hung riders fail typed / residents requeue, the "
+                        "lock wedge escalates to a supervised "
+                        "seal-and-exit + restart replay, every ACCEPT "
+                        "reaches a terminal state, each stall is detected "
+                        "within its bound, and stack dumps land on disk")
+    p.add_argument("--detect-slack-s", type=float, default=3.0,
+                   help="hang mode: allowed detection latency beyond the "
+                        "configured budget/deadline (monitor runs at 10Hz; "
+                        "this is host-scheduling headroom)")
     p.add_argument("--out", default=None,
                    help="optional JSON artifact for the run record")
     args = p.parse_args(argv)
 
     if args.churn:
         return churn_soak(args)
+    if args.hang:
+        return hang_soak(args)
 
     journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-chaos-")
     own_dir = args.journal_dir is None

@@ -478,6 +478,55 @@ def _serve_lint_rule(tmp_path, src: str, rules):
     return run_paths([f], root=tmp_path, rules=rules)
 
 
+# -- unbounded-blocking-wait --------------------------------------------------
+
+
+UNBOUNDED_WAIT_SRC = """
+    def loop(self, cond, ev, fut, q, d):
+        cond.wait()                      # flagged: timeout-less Condition
+        ev.wait()                        # flagged: timeout-less Event
+        fut.result()                     # flagged: timeout-less Future
+        q.get()                          # flagged: blocking Queue.get
+        cond.wait(timeout=0.1)           # bounded: fine
+        ev.wait(2.0)                     # positional timeout: fine
+        fut.result(timeout=5)            # bounded: fine
+        q.get(timeout=1.0)               # bounded: fine
+        d.get("key")                     # dict.get with args: never matches
+        d.get("key", None)               # ditto
+        fut.result(timeout=None)         # spelled-out unboundedness: flagged
+        ev.wait(None)                    # positional None: flagged too
+"""
+
+
+def test_unbounded_wait_flags_every_timeoutless_primitive(tmp_path):
+    findings = _serve_lint_rule(tmp_path, UNBOUNDED_WAIT_SRC,
+                                ["unbounded-blocking-wait"])
+    assert len(findings) == 6
+    assert {f.rule for f in findings} == {"unbounded-blocking-wait"}
+    # one finding per offending line, in order: wait/wait/result/get and
+    # the two spelled-out Nones (keyword and positional) at the end
+    assert [f.line for f in findings] == [3, 4, 5, 6, 13, 14]
+
+
+def test_unbounded_wait_scoped_to_serve(tmp_path):
+    # the same code under backend/ (or anywhere else) is out of scope —
+    # backends block inside device runtimes the lint cannot see anyway
+    f = tmp_path / "vnsum_tpu" / "backend" / "snippet.py"
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(UNBOUNDED_WAIT_SRC), encoding="utf-8")
+    assert run_paths([f], root=tmp_path,
+                     rules=["unbounded-blocking-wait"]) == []
+
+
+def test_unbounded_wait_suppression_with_reason_clears(tmp_path):
+    findings = _serve_lint_rule(tmp_path, """
+        def handler(self, fut):
+            # lint-allow[unbounded-blocking-wait]: request futures are resolved by every scheduler path
+            return fut.result()
+    """, ["unbounded-blocking-wait"])
+    assert findings == []
+
+
 # -- durable-write -----------------------------------------------------------
 
 

@@ -11,4 +11,5 @@ from . import (  # noqa: F401
     metrics_doc,
     recompile,
     swallowed,
+    unbounded_wait,
 )

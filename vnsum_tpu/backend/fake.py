@@ -130,6 +130,11 @@ class FakeBackend:
         # (every pre-cancellation caller unchanged)
         self._cancel_poll = None
         self.cancel_aborts = 0
+        # drain-wins flag (serve/scheduler.py close -> request_drain): a
+        # draining server must never wait out simulated device time — every
+        # sleep here is pure simulation, so aborting it changes wall clock,
+        # never outputs. Also cuts injected `latency` fault sleeps short
+        self._draining = False
 
     def _one(self, prompt: str) -> str:
         if self._responses is not None:
@@ -268,20 +273,47 @@ class FakeBackend:
         take_spec_report's duck typing."""
         self._cancel_poll = poll
 
+    def request_drain(self) -> None:
+        """Graceful-shutdown hook (duck-typed; serve/scheduler.py close):
+        abort in-flight and future simulated sleeps — including any armed
+        `latency` fault-plan sleeps — so drain always beats fake device
+        time. Outputs are unaffected; only the wall clock shrinks. Real
+        backends simply don't expose this."""
+        self._draining = True
+        from ..testing.faults import interrupt_sleeps
+
+        interrupt_sleeps()
+
+    def reset_drain(self) -> None:
+        """Undo request_drain (duck-typed; a NEW scheduler attaching to a
+        reused backend calls this): drain is scoped to the server that
+        drained, not to the backend's remaining lifetime — without the
+        reset, every later sleep and armed latency/hang fault would
+        pass through instantly and simulate nothing."""
+        self._draining = False
+        from ..testing.faults import reset_interrupts
+
+        reset_interrupts()
+
     def _sleep_cancellable(self, seconds: float) -> bool:
-        """The dispatch sleep, sliced at segment granularity when a cancel
-        poll is armed: each slice is one simulated decode segment
-        (``segment_words`` steps), and a poll returning True abandons the
-        remainder — the whole batch was cancelled, so burning more
-        simulated device time would only model waste. Returns True when
-        aborted."""
-        if self._cancel_poll is None:
-            time.sleep(seconds)
-            return False
-        seg = max(self.per_step_s * self.segment_words, 0.002)
+        """The dispatch sleep, sliced at segment granularity: each slice is
+        one simulated decode segment (``segment_words`` steps). An armed
+        cancel poll returning True abandons the remainder — the whole batch
+        was cancelled, so burning more simulated device time would only
+        model waste — and a draining server (request_drain) aborts
+        unconditionally: the sleep is simulation, and SIGTERM must win over
+        it. Returns True when aborted."""
+        # slice: segment-grained with a cancel poll armed (poll cadence is
+        # the contract), coarse 50ms otherwise (drain responsiveness only)
+        seg = (
+            max(self.per_step_s * self.segment_words, 0.002)
+            if self._cancel_poll is not None else 0.05
+        )
         t_end = time.monotonic() + seconds
         while True:
-            if self._cancel_poll():
+            if self._draining:
+                return True
+            if self._cancel_poll is not None and self._cancel_poll():
                 self.cancel_aborts += 1
                 return True
             remaining = t_end - time.monotonic()

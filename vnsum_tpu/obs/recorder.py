@@ -48,6 +48,9 @@ EVENT_KINDS = (
     "admit", "dispatch", "complete", "failed", "shed", "cancel",
     "preempt", "requeue", "fault", "bisect", "rung_change",
     "journal_replay", "slo_breach", "stream",
+    # watchdog liveness verdicts (serve/watchdog.py): a thread/dispatch
+    # declared stalled, and a wedged-dispatch recovery that answered it
+    "stall", "watchdog_recover",
 )
 
 _dump_ids = itertools.count(1)

@@ -60,9 +60,19 @@ This package is the missing online front-end for the batched engine:
                 TenantLabelRegistry (bounded metric cardinality): token/
                 outcome counters + windowed latency per tenant, served at
                 /v1/usage and as tenant-labeled series
+- watchdog.py   liveness: heartbeat registry for every long-lived serving
+                thread + a bounded-dispatch contract (token-derived
+                wall-clock budget per engine dispatch). Stalls snapshot
+                all thread stacks, classify (dispatch / lock / helper),
+                and recover: hung-dispatch riders resolve typed
+                RequestFailed(HUNG) or requeue via the journal's
+                replayable ACCEPT (slot loops) with the scheduler thread
+                replaced; lock/helper stalls escalate to a supervised
+                journal-seal-and-exit
 - server.py     stdlib HTTP front-end: /v1/summarize, /v1/generate,
                 /healthz, /metrics, /v1/usage, /debug/trace, /debug/slo,
-                /debug/flightrecorder  (python -m vnsum_tpu.serve.server)
+                /debug/flightrecorder, /debug/stacks
+                (python -m vnsum_tpu.serve.server)
 
 The engine itself is untouched: ONE scheduler thread owns all
 backend.generate calls (TpuBackend's jit caches and stats are not
@@ -83,6 +93,7 @@ from .qos import TenantSpec, TenantTable, TokenBucket, parse_tenant_specs
 from .slo import Objective, SloEngine, parse_slo_spec
 from .stream import StreamChannel, StreamDetached, StreamRegistry
 from .usage import TenantLabelRegistry, UsageLedger
+from .watchdog import WATCHDOG_EXIT_CODE, Watchdog, snapshot_stacks
 from .supervisor import (
     EngineSupervisor,
     FailureClass,
@@ -120,6 +131,9 @@ __all__ = [
     "TenantTable",
     "TokenBucket",
     "UsageLedger",
+    "WATCHDOG_EXIT_CODE",
+    "Watchdog",
     "parse_slo_spec",
     "parse_tenant_specs",
+    "snapshot_stacks",
 ]

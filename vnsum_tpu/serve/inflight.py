@@ -120,6 +120,10 @@ class InflightScheduler(MicroBatchScheduler):
         self._pending = []
         draining = False  # queue closed: serve what remains, then exit
         while True:
+            if self._stale_thread():
+                return  # replaced by watchdog recovery; the successor runs
+            if self._hb is not None:
+                self._hb.beat()
             try:
                 self._cancel_sweep_inflight(loop)
                 if not draining and self.tenants is not None:
@@ -133,6 +137,8 @@ class InflightScheduler(MicroBatchScheduler):
                         self._pending.extend(taken)
                 if draining and not self._pending and not active:
                     self._close_loop(loop)
+                    if self.watchdog is not None and not self._stale_thread():
+                        self.watchdog.unregister("scheduler")
                     return
                 if self._pending and not active:
                     key = self._pending[0].batch_key()
@@ -146,13 +152,26 @@ class InflightScheduler(MicroBatchScheduler):
                     and self._pending[0].batch_key() == loop_key
                     and loop.free
                 ):
-                    self._pending = self._admit(loop, self._pending)
+                    admitted = self._admit(loop, self._pending)
+                    if self._stale_thread():
+                        # hung admit: the successor owns _pending now — an
+                        # assignment here would clobber its taken work
+                        return
+                    self._pending = admitted
                 if loop is not None and loop.active:
                     self._run_segment(loop)
+                    if self._stale_thread():
+                        # hung segment: a late record_success here would
+                        # clear the very strike the recovery just charged
+                        return
                     if self.supervisor is not None:
                         self.supervisor.record_success()
                         self._apply_rung()
             except Exception as e:  # exercised by tests/test_serve_faults.py
+                if self._stale_thread():
+                    # a late error out of a loop the watchdog already tore
+                    # down and requeued: the successor owns everything now
+                    return
                 # a loop failure must not kill serving: every resident and
                 # pending request is evicted (slots freed, radix pins
                 # released by the loop's own finally paths) and resolved —
@@ -231,6 +250,53 @@ class InflightScheduler(MicroBatchScheduler):
             self._journal_fail(r, "error", str(e))
             if not r.future.done():
                 r.future.set_exception(e)
+
+    def recover_hung_dispatch(self, ticket) -> None:
+        """Wedged slot-loop recovery — runs ON THE WATCHDOG THREAD while
+        the scheduler thread is parked inside the hung ``admit``/``step``.
+
+        One-shot tickets (the oversized-prompt fallback) take the base
+        policy: riders fail typed HUNG. Slot kinds take the preemption
+        machinery instead (PR 12): the hang is the LOOP's fault, not the
+        riders', and their journaled ACCEPT payload is replayable — so the
+        loop is torn down (evict all residents, prefix blocks PINNED so the
+        restart prefill resumes warm, pins released at terminal resolution
+        like any preemption), every resident and taken-but-unadmitted
+        request is requeued, typed PREEMPTED/REQUEUED rides the journal,
+        and the replacement thread rebuilds a fresh loop and completes them
+        byte-identically (greedy; a sampled resident redraws its slot uid —
+        the same caveat class as crash recovery). The parked thread is
+        fenced by ``_stale_thread()``: its late return out of the closed
+        loop touches nothing."""
+        if ticket.kind == "one_shot":
+            super().recover_hung_dispatch(ticket)
+            return
+        # FENCE FIRST (see the base override): the wedged thread reads
+        # _stale_thread() == True from here on, so a hung admit/step that
+        # limps back mid-recovery cannot race _pending or the dying loop
+        successor = self._fence_replacement()
+        stranded = list(self._pending)
+        self._pending = []
+        loop = self._live_loop
+        evictions = []
+        if loop is not None:
+            residents = loop.outstanding()
+            if residents:
+                evictions = loop.evict(residents)
+            self._close_loop(loop)
+        logger.critical(
+            "watchdog recovery: hung %s — tearing down the slot loop, "
+            "requeueing %d resident(s) + %d pending",
+            ticket.kind, len(evictions), len(stranded),
+        )
+        for ev in evictions:
+            self._requeue_eviction(ev)
+        for r in stranded:
+            # taken off the queue but never slot-admitted: back it goes,
+            # verbatim (no engine state to unwind, no preempt event owed)
+            self.queue.requeue(r)
+        self._note_hang_strike()
+        self._start_replacement(successor)
 
     def _stranded_snapshot(self) -> list[ServeRequest]:
         stranded = list(self._pending)
@@ -372,25 +438,34 @@ class InflightScheduler(MicroBatchScheduler):
             # journaled — the crash point the soak's ledger audit covers
             time.sleep(self._preempt_gap_s)
         for ev in evictions:
-            r: ServeRequest = ev.key
-            r.preemptions += 1
-            if ev.pin is not None:
-                r.preempt_pins.append(ev.pin)
-            if self.journal is not None and r.journal_rid is not None:
-                self.journal.preempt(r.journal_rid)
-            self.metrics.observe_preemption(tenant=r.tenant)
-            self._fr("preempt", rid=r.trace_id, tenant=r.tenant,
-                     preemptions=r.preemptions)
-            self._trace_fault(r, "preempt", None, 0.0)
-            self.queue.requeue(r)
-            if self.journal is not None and r.journal_rid is not None:
-                self.journal.requeue(r.journal_rid)
-            self.metrics.observe_requeue(tenant=r.tenant)
-            self._fr("requeue", rid=r.trace_id, tenant=r.tenant)
+            self._requeue_eviction(ev)
         logger.info(
             "preempted %d batch-tier resident(s) for interactive demand",
             len(evictions),
         )
+
+    def _requeue_eviction(self, ev) -> None:
+        """THE eviction -> requeue bookkeeping, shared by tier preemption
+        (_maybe_preempt) and watchdog hang recovery so the two can never
+        drift: preemption count (it bills the preempt_budget starvation
+        bound either way — a request repeatedly displaced by hang recovery
+        is just as starved), pin carry, typed PREEMPTED/REQUEUED journal
+        events, metrics, flight-recorder events, and the trace span."""
+        r: ServeRequest = ev.key
+        r.preemptions += 1
+        if ev.pin is not None:
+            r.preempt_pins.append(ev.pin)
+        if self.journal is not None and r.journal_rid is not None:
+            self.journal.preempt(r.journal_rid)
+        self.metrics.observe_preemption(tenant=r.tenant)
+        self._fr("preempt", rid=r.trace_id, tenant=r.tenant,
+                 preemptions=r.preemptions)
+        self._trace_fault(r, "preempt", None, 0.0)
+        self.queue.requeue(r)
+        if self.journal is not None and r.journal_rid is not None:
+            self.journal.requeue(r.journal_rid)
+        self.metrics.observe_requeue(tenant=r.tenant)
+        self._fr("requeue", rid=r.trace_id, tenant=r.tenant)
 
     def _make_loop(self, head: ServeRequest):
         loop = self.backend.start_slot_loop(
@@ -440,7 +515,18 @@ class InflightScheduler(MicroBatchScheduler):
             return pending
         was_running = loop.active > 0
         items = [(r, r.prompt, r.cache_hint) for r in pending[: loop.free]]
-        admissions, rejected = loop.admit(items)
+        # bounded-dispatch contract: slot admission runs the joiners'
+        # chunked prefill — token-scaled budget like a one-shot dispatch
+        ticket = self._wd_begin("slot_admit", [r for r, _p, _h in items])
+        try:
+            admissions, rejected = loop.admit(items)
+        finally:
+            self._wd_end(ticket)
+        if self._stale_thread():
+            # the watchdog declared this admit hung, requeued every pending
+            # request, and replaced this thread: the late admissions belong
+            # to a torn-down loop
+            return []
         admitted_ids = {id(a.key) for a in admissions}
         rejected_ids = {id(k) for k in rejected}
         for adm in admissions:
@@ -482,7 +568,29 @@ class InflightScheduler(MicroBatchScheduler):
     # -- segment + harvest --------------------------------------------------
 
     def _run_segment(self, loop) -> None:
-        res = loop.step()
+        # bounded-dispatch contract: one decode segment is bounded work
+        # whatever the residents' prompts cost — flat segment budget.
+        # Deliberately rider-free: segments are the per-token-scale hot
+        # path, and recovery re-reads loop.outstanding() itself — a tuple
+        # of trace ids per segment would be allocation for a report field
+        ticket = None
+        if self.watchdog is not None:
+            ticket = self.watchdog.begin_dispatch(
+                "scheduler", "slot_segment", self.watchdog.segment_budget_s,
+            )
+        try:
+            res = loop.step()
+        finally:
+            self._wd_end(ticket)
+        if self._stale_thread():
+            # hung segment: the watchdog already evicted + requeued every
+            # RESIDENT and replaced this thread — but rows that finished in
+            # this very segment left the slots before the eviction saw
+            # them, so their futures are nobody else's to resolve: hand
+            # them back (recompute is byte-identical; a rider recovery DID
+            # resolve is a done-guarded no-op)
+            self._requeue_stale([c.key for c in res.completions])
+            return
         self.metrics.observe_segment(res.live, res.seconds, res.new_tokens)
         now = time.monotonic()
         self._emit_stream_deltas(loop)

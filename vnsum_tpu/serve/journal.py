@@ -58,6 +58,7 @@ from ..analysis.sanitizers import make_lock
 from ..core.artifacts import fsync_dir
 from ..core.logging import get_logger
 from ..obs.trace import emit
+from ..testing.faults import fault
 
 logger = get_logger("vnsum.serve.journal")
 
@@ -315,6 +316,13 @@ class RequestJournal:
             return  # rotation just fsynced
         now = time.monotonic()
         if now - self._last_sync >= self.fsync_interval_s:
+            # seeded injection point (vnsum_tpu.testing.faults, site
+            # `journal.fsync`): a `hang` here wedges the scheduler thread
+            # INSIDE the journal lock with no dispatch ticket armed — the
+            # watchdog's lock-classified stall, which must escalate to
+            # seal-and-exit (a replacement thread would deadlock on this
+            # very lock). Free when disarmed
+            fault("journal.fsync")
             os.fsync(self._file.fileno())
             self.fsyncs += 1
             self._last_sync = now
@@ -446,6 +454,7 @@ class RequestJournal:
         with self._lock:
             if self._file is not None and not self._closed:
                 t0 = time.monotonic()
+                fault("journal.fsync")
                 os.fsync(self._file.fileno())
                 self.fsyncs += 1
                 self._last_sync = time.monotonic()

@@ -210,6 +210,20 @@ _reg("usage_queue_wait_p99_seconds", "gauge",
 _reg("usage_tenants_overflowed", "gauge",
      "distinct tenant names collapsed into the 'other' overflow label by "
      "the capped registry (cardinality pressure probe)")
+# -- watchdog (serve/watchdog.py): hang/stall detection + recovery
+_reg("watchdog_stalls_total", "counter",
+     "stalls declared by the watchdog, by classification (dispatch = a "
+     "dispatch past its token-derived budget, lock = a loop thread wedged "
+     "outside the engine, helper = a helper thread went quiet)")
+_reg("watchdog_recoveries_total", "counter",
+     "wedged-dispatch recoveries completed (riders resolved typed HUNG or "
+     "requeued, scheduler thread replaced)")
+_reg("watchdog_hung_dispatches_total", "counter",
+     "engine dispatches declared HUNG (past their wall-clock budget)")
+_reg("watchdog_heartbeat_age_seconds", "gauge",
+     "seconds since each registered thread's last heartbeat, by thread "
+     "(scrape-time; mid-dispatch threads legitimately age until the "
+     "dispatch ticket ends)")
 # -- flight recorder (obs/recorder.py)
 _reg("recorder_events_total", "counter",
      "typed lifecycle events appended to the flight-recorder ring")
@@ -575,6 +589,7 @@ class ServeMetrics:
                           qos_state: dict | None = None,
                           slo_state: dict | None = None,
                           recorder_stats: dict | None = None,
+                          watchdog_stats: dict | None = None,
                           exemplars: bool = False) -> str:
         """``cache_stats`` is the backend's prefix_cache_stats() snapshot
         (evictions / blocks_used / blocks_total), read at scrape time like
@@ -803,6 +818,42 @@ class ServeMetrics:
             simple("recorder_events_dropped_total",
                    recorder_stats.get("dropped", 0))
             simple("recorder_dumps_total", recorder_stats.get("dumps", 0))
+        if watchdog_stats is not None:
+            # read from the live Watchdog at scrape time, like the queue
+            # gauges — the metrics layer never mirrors liveness state.
+            # Stable stall-kind label set, zeros included, so dashboards
+            # see every series before the first (hopefully never) stall
+            from .watchdog import STALL_KINDS
+
+            typ, help_ = _METRICS["watchdog_stalls_total"]
+            lines.append(f"# HELP {_PREFIX}watchdog_stalls_total {help_}")
+            lines.append(f"# TYPE {_PREFIX}watchdog_stalls_total {typ}")
+            stalls = watchdog_stats.get("stalls", {})
+            for kind in STALL_KINDS:
+                lines.append(
+                    # lint-allow[metric-label-cardinality]: STALL_KINDS is the watchdog's code-declared classification vocabulary — a fixed 3-entry tuple, never request-derived
+                    f'{_PREFIX}watchdog_stalls_total{{kind="{kind}"}} '
+                    f"{stalls.get(kind, 0)}"
+                )
+            simple("watchdog_recoveries_total",
+                   watchdog_stats.get("recoveries", 0))
+            simple("watchdog_hung_dispatches_total",
+                   watchdog_stats.get("hung_dispatches", 0))
+            ages = watchdog_stats.get("heartbeat_ages", {})
+            if ages:
+                typ, help_ = _METRICS["watchdog_heartbeat_age_seconds"]
+                lines.append(
+                    f"# HELP {_PREFIX}watchdog_heartbeat_age_seconds {help_}"
+                )
+                lines.append(
+                    f"# TYPE {_PREFIX}watchdog_heartbeat_age_seconds {typ}"
+                )
+                for name in sorted(ages):
+                    lines.append(
+                        f'{_PREFIX}watchdog_heartbeat_age_seconds'
+                        # lint-allow[metric-label-cardinality]: thread labels are registration-time code literals ("scheduler", "slo-monitor") — a bounded, operator-invisible set, never request-derived
+                        f'{{thread="{name}"}} {ages[name]}'
+                    )
         if degraded_rung is not None:
             # read from the live supervisor at scrape time, like the queue
             # gauges — the metrics layer never mirrors ladder state

@@ -220,6 +220,12 @@ class RequestQueue:
         # submissions only — internal fan-out of already-admitted requests
         # (force=True) must finish even under brownout
         self.degraded = None
+        # watchdog liveness stamp (serve/watchdog.py): the scheduler wires
+        # its Heartbeat.beat here so the take loops tick it on every
+        # wake-up — an IDLE scheduler parked in a bounded cond-wait still
+        # proves liveness. One attribute write per wake-up, called under
+        # the queue lock (beat takes no lock of its own). None = unmonitored
+        self.heartbeat = None
 
     # -- producer side ---------------------------------------------------
 
@@ -403,6 +409,8 @@ class RequestQueue:
         t_enter = time.monotonic()
         with self._cond:
             while True:
+                if self.heartbeat is not None:
+                    self.heartbeat()
                 now = time.monotonic()
                 self._shed_expired_locked(now)
                 if not self._items:
@@ -438,6 +446,8 @@ class RequestQueue:
         t_end = time.monotonic() + wait_s
         with self._cond:
             while True:
+                if self.heartbeat is not None:
+                    self.heartbeat()
                 now = time.monotonic()
                 self._shed_expired_locked(now)
                 if self._items:

@@ -91,10 +91,17 @@ class MicroBatchScheduler:
         journal=None,
         tenants=None,
         recorder=None,
+        watchdog=None,
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.backend = backend
+        # drain is scoped to A server, not the backend's lifetime: a
+        # backend reused across a closed-and-rebuilt scheduler (tests,
+        # multi-phase benches) must simulate real sleeps/faults again
+        reset_drain = getattr(backend, "reset_drain", None)
+        if callable(reset_drain):
+            reset_drain()
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.metrics = metrics or ServeMetrics()
@@ -175,6 +182,21 @@ class MicroBatchScheduler:
         # an operator flag — cancellation is part of the serving contract
         self.cancellation_enabled = True
         self._closed = False
+        # liveness (serve/watchdog.py): None = unmonitored (the pre-watchdog
+        # contract, and the bench A/B's off arm). With a Watchdog, the loop
+        # thread registers a heartbeat (beaten from the queue's wait loops,
+        # so an idle server still ticks), every engine dispatch is stamped
+        # with a token-derived wall-clock budget, and a dispatch past budget
+        # is recovered by recover_hung_dispatch ON THE WATCHDOG THREAD:
+        # riders resolve typed RequestFailed(HUNG) and this loop thread is
+        # REPLACED — the wedged one is fenced off by _stale_thread() checks
+        # at every boundary, so its late return can never double-resolve
+        self.watchdog = watchdog
+        self._hb = None
+        if watchdog is not None:
+            self._hb = watchdog.register("scheduler", kind="loop")
+            self.queue.heartbeat = self._hb.beat
+            watchdog.on_hung_dispatch = self.recover_hung_dispatch
         self._thread = threading.Thread(
             target=self._loop, name="vnsum-serve-scheduler", daemon=True
         )
@@ -452,6 +474,7 @@ class MicroBatchScheduler:
             trace=trace, trace_id=trace_id, trace_owned=trace_owned,
             tenant=tenant, tier=tier,
         )
+        # lint-allow[unbounded-blocking-wait]: externally bounded — these are request futures EVERY scheduler path resolves (success, typed failure, shed; drain-overrun sheds cover even a wedged engine, and the watchdog resolves hung dispatches typed)
         return [f.result() for f in futs]
 
     def backend_view(
@@ -519,8 +542,41 @@ class MicroBatchScheduler:
             return self.supervisor.batch_limit(self.max_batch)
         return self.max_batch
 
+    def _stale_thread(self) -> bool:
+        """True on a scheduler thread the watchdog has REPLACED: its
+        dispatch was declared hung, its riders were already resolved typed,
+        and a successor owns the loop — every boundary checks this so the
+        abandoned thread exits without touching shared state."""
+        return threading.current_thread() is not self._thread
+
+    def _requeue_stale(self, requests) -> None:
+        """A stale thread observing the fence while still HOLDING taken
+        work hands it back — never drops it. The case this exists for: a
+        falsely-hung dispatch (slow but alive) returns in the declaration
+        window, resolves its own riders, and takes a FRESH batch off the
+        queue before the fence flips; dropping that batch at the next
+        stale check would strand its futures forever, the one outcome this
+        package forbids. Requeue is safe here: these futures are
+        unresolved (the true-hang case resolved everything via recovery,
+        making this a no-op), queue.requeue admits even after close, and
+        the successor applies deadline discipline as usual."""
+        n = 0
+        for r in requests:
+            if not r.future.done():
+                self.queue.requeue(r)
+                n += 1
+        if n:
+            logger.warning(
+                "stale scheduler thread handed %d taken request(s) back "
+                "to the queue for the successor", n,
+            )
+
     def _loop(self) -> None:
         while True:
+            if self._stale_thread():
+                return  # replaced by watchdog recovery; the successor runs
+            if self._hb is not None:
+                self._hb.beat()
             try:
                 self._cancel_sweep()
                 batch = self.queue.take_batch(self._take_limit(),
@@ -530,7 +586,11 @@ class MicroBatchScheduler:
                 logger.exception("take_batch failed; scheduler continuing")
                 continue
             if batch is None:
-                return  # closed and drained
+                # closed and drained: a cleanly-exited loop must stop being
+                # monitored — a drained scheduler is not a stall
+                if self.watchdog is not None and not self._stale_thread():
+                    self.watchdog.unregister("scheduler")
+                return
             try:
                 self._run_batch(batch)
             except Exception as e:  # pragma: no cover - belt and braces
@@ -555,11 +615,19 @@ class MicroBatchScheduler:
                 try:
                     self._dispatch(batch)
                 except Exception as e:
+                    if self._stale_thread():
+                        # true hang: recovery resolved these typed HUNG (a
+                        # no-op requeue); false positive: hand them back
+                        self._requeue_stale(batch)
+                        return
                     self._resolve_errored(batch, e, *self._attempt_ctx)
                 return
             self._run_supervised(batch)
         finally:
-            self._dispatching = None
+            # identity-guarded: an abandoned thread waking from a hung
+            # dispatch must not null out the SUCCESSOR's live batch
+            if self._dispatching is batch:
+                self._dispatching = None
 
     def _dispatch(self, batch: list[ServeRequest]) -> None:
         """One engine dispatch: resolves every future on success; on failure
@@ -623,6 +691,7 @@ class MicroBatchScheduler:
             set_poll(lambda: all(
                 self._cancel_reason_for(r) is not None for r in batch
             ))
+        ticket = self._wd_begin("one_shot", batch)
         t0 = time.monotonic()
         try:
             with profile_cm:
@@ -635,16 +704,32 @@ class MicroBatchScheduler:
                 )
         except Exception:
             engine_s = time.monotonic() - t0
+            if self._stale_thread():
+                # this dispatch was declared HUNG and the riders resolved
+                # by the watchdog; the late error belongs to nobody
+                raise
             self._finish_batch_trace(bt, 0)
             self.metrics.observe_batch(len(batch), engine_s)
             logger.exception("engine batch of %d failed", len(batch))
             self._attempt_ctx = (t0, engine_s, bt)
             raise
         finally:
+            self._wd_end(ticket)
             if token is not None:
                 reset_collector(token)
-            if callable(set_poll) and self.cancellation_enabled:
+            if (callable(set_poll) and self.cancellation_enabled
+                    and not self._stale_thread()):
+                # a stale thread must not clear the SUCCESSOR's poll
                 set_poll(None)
+        if self._stale_thread():
+            # the watchdog already resolved every rider typed HUNG and a
+            # successor thread owns the loop: the late result is discarded
+            # (future.done() guards would drop it anyway — skipping the
+            # bookkeeping keeps metrics and the journal single-counted).
+            # Belt and braces for the fence-mid-bookkeeping window: any
+            # rider recovery did NOT resolve goes back to the queue
+            self._requeue_stale(batch)
+            return
         engine_s = time.monotonic() - t0
         if len(outs) != len(batch):
             # a zip would silently drop the tail and strand its futures
@@ -708,6 +793,115 @@ class MicroBatchScheduler:
             if not r.future.done():
                 r.future.set_result(_Completion(out, rec))
 
+    # -- watchdog (serve/watchdog.py) -------------------------------------
+
+    # decode-token assumption for dispatch budgets when a request carries no
+    # explicit max_new_tokens (the backend default is not visible here);
+    # budgets are ceilings, not estimates, so generous is correct
+    WATCHDOG_DEFAULT_NEW_TOKENS = 256
+
+    def _wd_begin(self, kind: str, batch: list[ServeRequest]):
+        """Stamp one engine dispatch with its wall-clock budget (the
+        bounded-dispatch contract): prompt tokens plus the decode ceiling,
+        through the watchdog's base+per-token formula. None when
+        unmonitored — the healthy path pays one `is None` check."""
+        wd = self.watchdog
+        if wd is None:
+            return None
+        head = batch[0]
+        tokens = sum(r.est_tokens for r in batch) + len(batch) * (
+            head.max_new_tokens or self.WATCHDOG_DEFAULT_NEW_TOKENS
+        )
+        return wd.begin_dispatch(
+            "scheduler", kind, wd.dispatch_budget(tokens),
+            riders=tuple(r.trace_id for r in batch), tokens=tokens,
+        )
+
+    def _wd_end(self, ticket) -> None:
+        if ticket is not None:
+            self.watchdog.end_dispatch(ticket)
+
+    def recover_hung_dispatch(self, ticket) -> None:
+        """Wedged-dispatch recovery — runs ON THE WATCHDOG THREAD while the
+        scheduler thread is still parked inside the engine call it will
+        never (or too late) return from. Everything touched here is
+        thread-safe by construction (futures, the journal, metrics, the
+        queue) or parked-thread state the fences make safe to read.
+
+        One-shot dispatch: every unresolved rider fails typed
+        ``RequestFailed(HUNG)`` — retryable from the client's seat, typed
+        FAILED in the ledger (the journal replay can't resurrect work whose
+        dispatch wedged the engine). The ladder takes a resource strike and
+        the loop thread is replaced; the abandoned one is fenced by
+        ``_stale_thread()`` at every boundary. The in-flight subclass
+        overrides the slot-loop kinds to REQUEUE instead (the hang there is
+        the loop's fault, not the riders')."""
+        from .supervisor import FailureClass, RequestFailed
+
+        # FENCE FIRST: installing the (unstarted) successor flips
+        # _stale_thread() for the wedged thread before any shared state is
+        # touched — a dispatch that limps back at budget+epsilon hits a
+        # stale check at its next boundary instead of racing this recovery
+        # (the residual window is the boundary check itself; future.done()
+        # guards and the journal's terminal no-ops bound what a loser of
+        # that race can do to double-bookkeeping, never corruption)
+        successor = self._fence_replacement()
+        riders = [r for r in (self._dispatching or [])
+                  if not r.future.done()]
+        exc = RequestFailed(
+            FailureClass.HUNG,
+            detail=(f"engine dispatch exceeded its {ticket.budget_s:.1f}s "
+                    f"watchdog budget ({ticket.kind})"),
+        )
+        if riders:
+            logger.critical(
+                "watchdog recovery: failing %d rider(s) of the hung %s "
+                "dispatch typed HUNG", len(riders), ticket.kind,
+            )
+            # clock discipline: ticket timestamps live in the WATCHDOG's
+            # clock space (synthetic under test) — derive the stall age
+            # there, then anchor the record in this scheduler's monotonic
+            # space so queue-wait math against enqueued_at stays coherent
+            age = max(self.watchdog.now() - ticket.started_at, 0.0)
+            t0 = time.monotonic() - age
+            self._resolve_errored(riders, exc, t0, age, None)
+        self._note_hang_strike()
+        self._start_replacement(successor)
+
+    def _note_hang_strike(self) -> None:
+        """A hang is too-hot-operating-point evidence like an OOM: the
+        degradation ladder takes a resource-class strike."""
+        from .supervisor import FailureClass
+
+        sup = self.supervisor
+        if sup is None:
+            return
+        self.metrics.observe_failure(FailureClass.HUNG.value)
+        sup.note_failure(FailureClass.HUNG)
+        # rung EFFECTS still apply lazily on the (new) engine thread at its
+        # next dispatch — _apply_rung stays scheduler-thread-only
+
+    def _fence_replacement(self) -> threading.Thread:
+        """Create the successor loop thread WITHOUT starting it and install
+        it as ``self._thread`` — reassignment IS the fence: from this
+        instant the wedged thread reads ``_stale_thread() == True`` at
+        every boundary and exits without touching shared state (its
+        in-flight engine call is sunk cost). Recovery mutates shared state
+        between this call and ``_start_replacement``, single-threaded."""
+        t = threading.Thread(
+            target=self._loop, name="vnsum-serve-scheduler", daemon=True
+        )
+        self._thread = t
+        return t
+
+    def _start_replacement(self, successor: threading.Thread) -> None:
+        """Recovery's last act: re-beat the heartbeat (the successor must
+        not start life already stalled) and let it serve."""
+        if self._hb is not None:
+            self._hb.beat()
+        successor.start()
+        logger.warning("watchdog recovery: scheduler thread replaced")
+
     # -- supervision (serve/supervisor.py) --------------------------------
 
     def _run_supervised(self, batch: list[ServeRequest]) -> None:
@@ -718,6 +912,12 @@ class MicroBatchScheduler:
         sup = self.supervisor
         work: list[list[ServeRequest]] = [batch]
         while work:
+            if self._stale_thread():
+                # watchdog recovery owns the hung dispatch's riders; any
+                # OTHER unresolved work this thread still holds (a batch
+                # taken in the declaration window) goes back to the queue
+                self._requeue_stale([r for g in work for r in g])
+                return
             group = [r for r in work.pop() if not r.future.done()]
             # deadline discipline survives retries: an expired rider is
             # shed typed, never redispatched
@@ -739,6 +939,13 @@ class MicroBatchScheduler:
                 sup.record_success()
                 self._apply_rung()
             except Exception as e:
+                if self._stale_thread():
+                    # late error from a dispatch already declared HUNG —
+                    # recovery resolved ITS riders; hand anything else back
+                    self._requeue_stale(
+                        [r for g in work for r in g] + group
+                    )
+                    return
                 self._resolve_dispatch_failure(group, e, work)
 
     def _resolve_dispatch_failure(
@@ -992,8 +1199,21 @@ class MicroBatchScheduler:
         daemon and every resolution site guards future.done(), so a late
         engine completion is dropped harmlessly."""
         self._closed = True
+        # drain beats an in-flight SLEEP: backends with a simulated latency
+        # model (FakeBackend, and the injected `latency` fault kind) abort
+        # their sleeps on request_drain, so a graceful SIGTERM never waits
+        # out fake device time — outputs are unaffected (the sleep is pure
+        # simulation), only the wall clock shrinks. Real backends simply
+        # don't expose the hook
+        drain_hook = getattr(self.backend, "request_drain", None)
+        if callable(drain_hook):
+            drain_hook()
         self.queue.close(drain=drain)
         self._thread.join(timeout=timeout)
+        if self.watchdog is not None:
+            # closed (drained or overrun): either way this scheduler stops
+            # being monitored — shutdown must not read as a stall
+            self.watchdog.unregister("scheduler")
         if self._thread.is_alive():
             shed_queued = self.queue.shed_pending()
             stranded = self._stranded_snapshot()

@@ -59,6 +59,12 @@ Endpoints:
                         the flight recorder's typed-event ring
                         (obs/recorder.py); anomalies also dump it to
                         --flight-dir
+    GET /debug/stacks   every thread's Python stack on demand — the manual
+                        twin of the watchdog's automatic stall dump
+                        (serve/watchdog.py); SIGUSR1 writes the same
+                        snapshot to --flight-dir. /healthz carries the
+                        watchdog verdict (last-beat age per registered
+                        thread, stall/recovery counters)
     GET /debug/trace    Chrome trace-event JSON of the recent-request ring
                         (vnsum_tpu.obs) — load in ui.perfetto.dev; one track
                         per request, one per engine batch. ?save=1 also
@@ -139,6 +145,12 @@ class ServeState:
         flight_events: int = 4096,
         flight_recorder: bool = True,
         windowed_metrics: bool = True,
+        watchdog: bool = True,
+        watchdog_interval_s: float = 0.5,
+        watchdog_stall_s: float = 10.0,
+        watchdog_dispatch_base_s: float = 30.0,
+        watchdog_dispatch_per_token_s: float = 0.01,
+        watchdog_exit_on_escalate: bool = True,
     ) -> None:
         self.backend = backend
         # uptime anchors for /healthz (monotonic for the math, wall clock
@@ -229,6 +241,33 @@ class ServeState:
             FlightRecorder(capacity=flight_events, directory=flight_dir)
             if flight_recorder else None
         )
+        # liveness (serve/watchdog.py, this PR's tentpole): heartbeat
+        # registry + bounded-dispatch contract + stall recovery. ON by
+        # default — hang detection is part of the serving contract;
+        # watchdog=False is the bench A/B's off arm, never an operator
+        # flag (--no-watchdog exists for debugging a misbehaving detector,
+        # not for production). Escalation (lock/helper stalls, where a
+        # replacement thread would deadlock too) is a supervised
+        # journal-seal-and-exit: WATCHDOG_EXIT_CODE tells the process
+        # manager to restart, and journal replay restores state.
+        # watchdog_exit_on_escalate=False (tests/benches embedding a
+        # ServeState in-process) records + seals but keeps the process
+        self.watchdog = None
+        self._watchdog_escalations = 0
+        if watchdog:
+            from .watchdog import Watchdog
+
+            self._watchdog_exit = watchdog_exit_on_escalate
+            self.watchdog = Watchdog(
+                interval_s=watchdog_interval_s,
+                loop_deadline_s=watchdog_stall_s,
+                helper_deadline_s=max(watchdog_stall_s * 6, 60.0),
+                dispatch_base_s=watchdog_dispatch_base_s,
+                dispatch_per_token_s=watchdog_dispatch_per_token_s,
+                recorder=self.recorder,
+                dump_dir=flight_dir,
+                on_escalate=self._watchdog_escalate,
+            )
         common = dict(
             max_batch=max_batch,
             max_wait_s=max_wait_s,
@@ -241,6 +280,7 @@ class ServeState:
             journal=self.journal,
             tenants=tenants,
             recorder=self.recorder,
+            watchdog=self.watchdog,
         )
         if inflight:
             # in-flight batching (serve/inflight.py): slot-feeding over the
@@ -273,7 +313,17 @@ class ServeState:
                 breach_fast_burn=slo_burn_fast,
                 breach_slow_burn=slo_burn_slow,
                 recorder=self.recorder,
+                # helper-kind heartbeat: a wedged SLO evaluation is a
+                # detected stall, not a silent end of judgement
+                heartbeat=(
+                    self.watchdog.register("slo-monitor", kind="helper")
+                    if self.watchdog is not None else None
+                ),
             )
+        if self.watchdog is not None:
+            # monitor thread starts LAST: every heartbeat is registered
+            # (and freshly beaten) before the first detection pass
+            self.watchdog.start()
         self.default_deadline_s = default_deadline_s
         self._strategies: dict[str, object] = {}
         import threading
@@ -427,7 +477,43 @@ class ServeState:
             )
         return payload
 
+    def _watchdog_escalate(self, stall) -> None:
+        """Lock/helper-stall escalation (serve/watchdog.py): the big
+        hammer. A thread wedged in a LOCK wait (e.g. mid-fsync inside the
+        journal lock) cannot be replaced — the successor would deadlock on
+        the same lock — so the supervised answer is seal-and-exit: dump the
+        flight ring, best-effort seal the journal on a side thread (the
+        wedged thread may HOLD the journal lock, so the seal gets a bounded
+        wait, and an unsealed journal replays fine — that is the normal
+        crash path), and exit with WATCHDOG_EXIT_CODE so the process
+        manager restarts us and journal replay restores every accepted
+        request. Runs on the watchdog thread."""
+        import threading as _threading
+
+        from .watchdog import WATCHDOG_EXIT_CODE
+
+        self._watchdog_escalations += 1
+        logger.critical(
+            "watchdog escalation: %s stall on %r (%.2fs past %.2fs) — "
+            "sealing the journal and exiting %d for a supervised restart",
+            stall.kind, stall.name, stall.stalled_for_s, stall.limit_s,
+            WATCHDOG_EXIT_CODE,
+        )
+        if self.recorder is not None:
+            self.recorder.dump("watchdog_escalate")
+        if self.journal is not None:
+            t = _threading.Thread(target=self.journal.seal, daemon=True)
+            t.start()
+            t.join(timeout=2.0)
+        if not self._watchdog_exit:
+            return  # embedded/test mode: the verdict is recorded, we live
+        os._exit(WATCHDOG_EXIT_CODE)
+
     def close(self, drain_timeout_s: float = 30.0) -> None:
+        if self.watchdog is not None:
+            # the monitor stops FIRST: a drain parked in journal seal or a
+            # slow final dispatch must never be declared a stall mid-exit
+            self.watchdog.close()
         if self.slo is not None:
             self.slo.close()
         self.scheduler.close(drain=True, timeout=drain_timeout_s)
@@ -595,6 +681,17 @@ def make_handler(state: ServeState):
                     self._json({"error": "flight recorder disabled"}, 404)
                     return
                 self._json(state.recorder.snapshot())
+            elif path == "/debug/stacks":
+                # every thread's Python stack on demand — the manual twin
+                # of the watchdog's automatic stall dump (SIGUSR1 writes
+                # the same snapshot to disk). Always available: hangs are
+                # exactly when an operator needs this, watchdog or not
+                from .watchdog import snapshot_stacks
+
+                payload = {"threads": snapshot_stacks()}
+                if state.watchdog is not None:
+                    payload["watchdog"] = state.watchdog.health_dict()
+                self._json(payload)
             elif path == "/v1/usage":
                 self._usage(query)
             elif path.startswith("/v1/requests/"):
@@ -622,6 +719,12 @@ def make_handler(state: ServeState):
                     # the one-line SLO verdict: probes and humans read the
                     # same judgement the gauges and /debug/slo render
                     payload["slo"] = state.slo.status_line()
+                if state.watchdog is not None:
+                    # liveness verdict: last-beat age per registered thread
+                    # plus the stall/recovery counters — a probe reading
+                    # /healthz sees a wedged loop as a growing age, then a
+                    # counted stall, without waiting for client timeouts
+                    payload["watchdog"] = state.watchdog.health_dict()
                 mesh_state = state.mesh_state()
                 if mesh_state is not None:
                     # echo the serving mesh so probes/load balancers can
@@ -692,6 +795,10 @@ def make_handler(state: ServeState):
                         recorder_stats=(
                             state.recorder.stats_dict()
                             if state.recorder is not None else None
+                        ),
+                        watchdog_stats=(
+                            state.watchdog.stats_dict()
+                            if state.watchdog is not None else None
                         ),
                         exemplars=openmetrics,
                     )
@@ -983,6 +1090,7 @@ def make_handler(state: ServeState):
             non-streaming payload on success, a typed error event
             otherwise."""
             try:
+                # lint-allow[unbounded-blocking-wait]: externally bounded — the drain loop only calls finish() after fut.done() turned true, so this result() never blocks
                 c = fut.result()
             except Exception as e:
                 return self._stream_error_event(e)
@@ -1623,6 +1731,32 @@ def main(argv: list[str] | None = None) -> int:
                         "/debug/flightrecorder only, no dumps")
     p.add_argument("--flight-events", type=int, default=4096,
                    help="flight-recorder ring capacity (events)")
+    p.add_argument("--no-watchdog", action="store_true",
+                   help="disable hang/stall detection (serve/watchdog.py). "
+                        "Debug lever only — without it a wedged dispatch "
+                        "freezes the scheduler silently until every client "
+                        "times out")
+    p.add_argument("--watchdog-interval-s", type=float, default=0.5,
+                   help="watchdog monitor cadence (detection latency adds "
+                        "at most one interval on top of the exceeded "
+                        "budget/deadline)")
+    p.add_argument("--watchdog-stall-s", type=float, default=10.0,
+                   help="heartbeat deadline for loop threads: a scheduler "
+                        "loop quiet this long OUTSIDE a budgeted dispatch "
+                        "is a lock-classified stall (escalates to "
+                        "seal-and-exit; helper threads get 6x this)")
+    p.add_argument("--watchdog-dispatch-budget-s", type=float, default=30.0,
+                   help="base wall-clock budget per engine dispatch; the "
+                        "token-derived term is added on top, and a "
+                        "dispatch past its budget is declared HUNG "
+                        "(riders resolve typed, the scheduler thread is "
+                        "replaced)")
+    p.add_argument("--watchdog-dispatch-per-token-ms", type=float,
+                   default=10.0,
+                   help="per-token addition to the dispatch budget "
+                        "(prompt + decode-ceiling tokens), so big batches "
+                        "earn proportionally longer budgets instead of "
+                        "tripping a one-size timeout")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="graceful-shutdown drain budget before queued and "
                         "in-flight requests are shed typed")
@@ -1758,6 +1892,13 @@ def main(argv: list[str] | None = None) -> int:
         slo_burn_slow=args.slo_burn_slow,
         flight_dir=args.flight_dir,
         flight_events=args.flight_events,
+        watchdog=not args.no_watchdog,
+        watchdog_interval_s=args.watchdog_interval_s,
+        watchdog_stall_s=args.watchdog_stall_s,
+        watchdog_dispatch_base_s=args.watchdog_dispatch_budget_s,
+        watchdog_dispatch_per_token_s=(
+            args.watchdog_dispatch_per_token_ms / 1000.0
+        ),
     )
     if args.inflight:
         state.scheduler.preempt_budget = max(args.preempt_budget, 1)
@@ -1783,9 +1924,41 @@ def main(argv: list[str] | None = None) -> int:
 
         threading.Thread(target=server.shutdown, daemon=True).start()
 
+    def _stacks_on_demand(signum, frame):
+        # SIGUSR1: the manual twin of the watchdog's automatic stall dump —
+        # `kill -USR1 <pid>` when the server LOOKS wedged writes every
+        # thread's stack to --flight-dir (or logs it with nowhere to write).
+        # Runs in the main thread's signal trampoline: snapshotting is
+        # read-only and allocation-light, safe even mid-wedge
+        from ..core.artifacts import atomic_write_json
+        from .watchdog import snapshot_stacks
+
+        stacks = snapshot_stacks()
+        if args.flight_dir:
+            import pathlib
+
+            path = pathlib.Path(args.flight_dir) / (
+                f"watchdog_sigusr1_{int(time.time() * 1000)}.json"
+            )
+            try:
+                atomic_write_json(path, {
+                    "reason": "sigusr1", "dumped_wall": time.time(),
+                    "stacks": stacks,
+                })
+                logger.warning("SIGUSR1: wrote stack dump %s", path)
+                return
+            # lint-allow[swallowed-exception]: the log fallback below IS the answer — an unwritable flight dir must not crash the signal trampoline
+            except OSError:
+                logger.exception("SIGUSR1 stack dump failed; logging")
+        for t in stacks:
+            logger.warning("SIGUSR1 stack [%s]:\n%s", t["name"],
+                           "\n".join(t["stack"]))
+
     try:
         signal.signal(signal.SIGTERM, _graceful)
         signal.signal(signal.SIGINT, _graceful)
+        if hasattr(signal, "SIGUSR1"):
+            signal.signal(signal.SIGUSR1, _stacks_on_demand)
     # lint-allow[swallowed-exception]: no request exists yet to resolve — logging that the embedding caller keeps signal ownership IS the handling
     except ValueError:
         # not the main thread (embedded/test use): the caller owns lifecycle

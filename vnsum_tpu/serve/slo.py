@@ -155,6 +155,7 @@ class SloEngine:
         breach_slow_burn: float = 1.0,
         recorder=None,
         interval_s: float = 1.0,
+        heartbeat=None,
     ) -> None:
         if not objectives:
             raise ValueError("SloEngine needs at least one objective")
@@ -174,6 +175,11 @@ class SloEngine:
         self._breached: set[str] = set()   # guarded by: _lock
         self.breaches_total = 0            # monotone; racy reads fine
         self._last_breach: dict | None = None  # guarded by: _lock
+        # watchdog liveness stamp (serve/watchdog.py): a helper-kind
+        # Heartbeat the monitor loop beats once per evaluation tick, so a
+        # wedged evaluation (stuck metrics lock) is detected and escalated
+        # instead of silently stopping SLO judgement. None = unmonitored
+        self.heartbeat = heartbeat
         self._stop = threading.Event()
         self._thread = None
         if interval_s and interval_s > 0:
@@ -374,6 +380,8 @@ class SloEngine:
     def _monitor(self) -> None:
         while not self._stop.wait(self._interval_s):
             try:
+                if self.heartbeat is not None:
+                    self.heartbeat.beat()
                 self.evaluate()
             # lint-allow[swallowed-exception]: the monitor is an alerting sidecar — an evaluation bug must not kill it (the next tick retries) and there is no request to resolve
             except Exception:

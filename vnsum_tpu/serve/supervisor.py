@@ -75,6 +75,13 @@ class FailureClass(str, Enum):
     RESOURCE = "resource_exhausted"
     POISON = "poison"
     FATAL = "fatal"
+    # a dispatch that never RETURNED (serve/watchdog.py): declared past its
+    # wall-clock budget, riders resolved typed without an exception ever
+    # firing. Retryable from the client's seat (the request itself is not
+    # implicated — re-submission rides the normal supervised path), and a
+    # ladder strike from the server's (a host that hangs dispatches is a
+    # host running too hot)
+    HUNG = "hung"
 
 
 class Rung(IntEnum):
@@ -223,8 +230,10 @@ class EngineSupervisor:
         and at-max-rung included — restamps the recovery clock: the probe
         interval measures quiet time since the last strike, not since the
         last rung change, so the ladder can't oscillate back up into an
-        operating point that is still failing."""
-        if cls is not FailureClass.RESOURCE:
+        operating point that is still failing. HUNG counts as a resource
+        strike (serve/watchdog.py): a wedged dispatch is the same
+        too-hot-operating-point evidence an OOM is."""
+        if cls not in (FailureClass.RESOURCE, FailureClass.HUNG):
             return
         with self._lock:
             self._last_change = time.monotonic()

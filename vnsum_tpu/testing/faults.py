@@ -18,6 +18,9 @@ site                  fires
 ``engine.dispatch``   TpuBackend.generate entry
 ``engine.slot_admit`` TpuSlotLoop.admit entry
 ``engine.slot_step``  TpuSlotLoop.step entry
+``journal.fsync``     RequestJournal group-commit fsync — fires INSIDE the
+                      journal lock on the scheduler thread (the mid-fsync
+                      wedge the watchdog classifies as a lock stall)
 ====================  ======================================================
 
 Fault kinds map one-to-one onto the supervisor's failure classes:
@@ -30,8 +33,15 @@ Fault kinds map one-to-one onto the supervisor's failure classes:
 - ``poison``    — fires only when a prompt in the dispatch contains
   ``match``; deterministic per batch CONTENT, which is exactly the
   poison-request scenario bisection quarantines
-- ``latency``   — ``time.sleep(delay_s)`` instead of raising (SLO pressure:
-  deadline sheds, drain timeouts)
+- ``latency``   — sleep ``delay_s`` instead of raising (SLO pressure:
+  deadline sheds, drain timeouts); the sleep is an interruptible Event
+  wait, so :func:`interrupt_sleeps` (the drain path) can cut it short
+- ``hang``      — block at the site until released: ``delay_s > 0`` holds
+  that long ("block until released" with an automatic release), ``delay_s``
+  of 0 blocks FOREVER (until :func:`release_hangs` / process death). The
+  watchdog's (serve/watchdog.py) stall-detection and wedged-dispatch
+  recovery paths are unreachable any other way — nothing in a healthy
+  backend ever just stops returning
 
 Arming: programmatically (:func:`arm` / :func:`injected`), or hermetically
 for a whole process via ``VNSUM_FAULTS``, e.g.::
@@ -48,7 +58,6 @@ from __future__ import annotations
 import os
 import random
 import threading
-import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -79,7 +88,7 @@ class InjectedResourceExhausted(InjectedFault):
         )
 
 
-_KINDS = ("raise", "resource", "fatal", "poison", "latency")
+_KINDS = ("raise", "resource", "fatal", "poison", "latency", "hang")
 
 
 @dataclass
@@ -144,6 +153,34 @@ class FaultPlan:
         self._lock = threading.Lock()
         # (site, kind, per-site call index) per firing, for test assertions
         self.fired: list[tuple[str, str, int]] = []
+        # hang kinds park on this until release_hangs() (or their own
+        # delay_s elapses); latency kinds wait on the interrupt event so a
+        # draining server can cut a simulated sleep short (the drain-wins
+        # contract) — both are plan-scoped, so disarming forgets them
+        self._hang_release = threading.Event()
+        self._sleep_interrupt = threading.Event()
+
+    def release_hangs(self) -> None:
+        """Unblock every thread parked in a ``hang`` fault (tests; the
+        watchdog never needs it — recovery treats the thread as lost)."""
+        self._hang_release.set()
+
+    def interrupt_sleeps(self) -> None:
+        """Cut every in-flight ``latency`` sleep short AND release hangs —
+        what a draining backend calls so a graceful shutdown never waits
+        out an injected stall (module-level :func:`interrupt_sleeps`
+        routes here for the armed plan)."""
+        self._sleep_interrupt.set()
+        self._hang_release.set()
+
+    def reset_interrupts(self) -> None:
+        """Re-arm latency/hang blocking after a drain: interrupts are
+        one-shot Events, and a plan kept armed across a closed-and-rebuilt
+        server would otherwise simulate nothing (every sleep instant,
+        every hang pass-through) — a vacuously green chaos run. Called
+        when a new scheduler attaches (FakeBackend.reset_drain)."""
+        self._sleep_interrupt.clear()
+        self._hang_release.clear()
 
     def calls(self, site: str) -> int:
         with self._lock:
@@ -173,7 +210,14 @@ class FaultPlan:
             "injecting %s at %s (call %d)", hit.kind, site, n
         )
         if hit.kind == "latency":
-            time.sleep(hit.delay_s)
+            # interruptible: a draining backend cuts the simulated stall
+            # short via interrupt_sleeps() instead of waiting it out
+            self._sleep_interrupt.wait(hit.delay_s)
+        elif hit.kind == "hang":
+            # the wedge under test: no exception, no return — until
+            # released (delay_s > 0 auto-releases; 0 = forever). The
+            # watchdog must detect and recover AROUND this thread
+            self._hang_release.wait(hit.delay_s if hit.delay_s > 0 else None)
         elif hit.kind == "resource":
             raise InjectedResourceExhausted(site, n)
         elif hit.kind == "fatal":
@@ -255,3 +299,19 @@ def fault(site: str, prompts=None) -> None:
     """THE dispatch-site hook: free when disarmed (one global read)."""
     if _PLAN is not None:
         _PLAN.fire(site, prompts)
+
+
+def interrupt_sleeps() -> None:
+    """Cut the armed plan's latency sleeps short and release its hangs —
+    the backend drain hook (FakeBackend.request_drain). No-op when
+    disarmed."""
+    if _PLAN is not None:
+        _PLAN.interrupt_sleeps()
+
+
+def reset_interrupts() -> None:
+    """Undo :func:`interrupt_sleeps` on the armed plan — a NEW server
+    attaching to a still-armed plan must get real latency/hang simulation,
+    not the previous drain's pass-through. No-op when disarmed."""
+    if _PLAN is not None:
+        _PLAN.reset_interrupts()
