@@ -553,6 +553,165 @@ def sharded_phase(args) -> dict:
     }
 
 
+def fleet_phase(args) -> dict:
+    """Replica-fleet front door (serve/router.py, ISSUE 16 tentpole):
+    in-process engine workers behind an in-process RouterState — hermetic
+    like every other phase, so the measured deltas are routing policy, not
+    subprocess noise. Two experiments:
+
+    1. **goodput scaling** — the r04 mixed short/long workload through the
+       router at 1 vs 2 fake workers, offered load sized to saturate both
+       arms: the front door + fan-out must actually buy capacity
+       (>= --fleet-min-scaling), not just add a hop.
+    2. **cache affinity** — a shared-prefix workload with 8 distinct
+       ``cache_hint`` keys through 2 workers: rendezvous hashing must keep
+       each hint's reuse on one worker, holding the aggregate prefix-cache
+       hit rate within 10% of a single process that sees every request
+       (>= --fleet-min-affinity of the single-process rate)."""
+    from vnsum_tpu.serve.router import (
+        RouterState,
+        Worker as FleetWorker,
+        make_router_server,
+    )
+
+    deadline_s = args.deadline_s
+    short = "tin ngan gon sau day chi tam tu"                        # 8 words
+    long_ = "phan tich chuyen sau ve tinh hinh kinh te xa hoi " * 6  # 54
+
+    def run_fleet(n_workers, backend_kwargs, clients, per_client,
+                  payload_fn, loop_deadline_s=None):
+        """Closed-loop load through a router over N in-process workers ->
+        (loop stats, per-worker request spread, aggregate cache tokens)."""
+        workers, parts = [], []
+        for k in range(n_workers):
+            backend = FakeBackend(**backend_kwargs)
+            state = ServeState(
+                backend,
+                max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1000.0,
+                max_queue_depth=128,
+                trace_sample=0.0,
+            )
+            server = make_server(state, "127.0.0.1", 0)
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            workers.append(FleetWorker(f"w{k}", "127.0.0.1",
+                                       server.server_address[1]))
+            parts.append((backend, state, server))
+        rstate = RouterState(workers, probe_interval_s=0.05,
+                             probe_timeout_s=2.0, down_after=2, up_after=1)
+        rstate.start()
+        rserver = make_router_server(rstate, "127.0.0.1", 0)
+        threading.Thread(target=rserver.serve_forever, daemon=True).start()
+        rstate.wait_ready(timeout_s=10.0)
+        base = f"http://127.0.0.1:{rserver.server_address[1]}"
+        loop = closed_loop(base, clients, per_client,
+                           loop_deadline_s or deadline_s, payload_fn)
+        rserver.shutdown()
+        rserver.server_close()
+        rstate.close(drain_timeout_s=2.0)
+        spread = [w.requests for w in workers]
+        hit_tokens = prompt_tokens = 0
+        for _backend, state, server in parts:
+            server.shutdown()
+            server.server_close()
+            snap = state.scheduler.metrics.snapshot()
+            hit_tokens += snap.cache_hit_tokens
+            prompt_tokens += snap.prompt_tokens
+            state.close()
+        return loop, spread, hit_tokens, prompt_tokens
+
+    # -- 1) goodput scaling: 1 vs 2 workers, identical saturating load ----
+    # 6x max_batch clients: after a batch completes its responses round-trip
+    # through the router before those clients resubmit, so each worker needs
+    # ~2 full batches of standing queue (x2 workers) to never run a partial
+    # batch while the window is in flight
+    clients = max(args.clients, 6 * args.max_batch)
+    # queue sojourn at single-worker saturation is ~clients/goodput which
+    # brushes the default 2s SLA; the scaling arm measures throughput, not
+    # deadline pressure, so give it slack
+    scale_deadline_s = deadline_s * 2
+
+    def mixed_payload(cid, i):
+        return {
+            "prompt": short if (cid + i) % 2 else long_,
+            "deadline_ms": scale_deadline_s * 1000,
+        }
+
+    # per-worker capacity must be the bottleneck, not the front door: a
+    # single proxying ThreadingHTTPServer tops out well above one worker's
+    # throughput but not 2x a fast one, so the fleet arm charges a heavier
+    # per-dispatch overhead than the single-process phases — the scaling
+    # under test is worker fan-out, and it only shows when workers are
+    # what saturates
+    scale_kwargs = dict(batch_overhead_s=args.fleet_batch_overhead_s,
+                        per_prompt_s=args.per_prompt_s)
+    arms = {}
+    for name, n in (("fleet1", 1), ("fleet2", 2)):
+        loop, spread, _, _ = run_fleet(
+            n, scale_kwargs, clients, args.per_client, mixed_payload,
+            loop_deadline_s=scale_deadline_s,
+        )
+        arms[name] = {**loop, "workers": n, "worker_requests": spread}
+    scaling = (
+        round(arms["fleet2"]["goodput_rps"] / arms["fleet1"]["goodput_rps"],
+              3)
+        if arms["fleet1"]["goodput_rps"] else float("inf")
+    )
+
+    # -- 2) cache affinity: 8 hint keys, 2 workers vs 1 process -----------
+    preambles = [
+        f"Chủ đề {k}: " + "bối cảnh chuyên sâu về lĩnh vực này cần nắm "
+        "trước khi tóm tắt. " * 24
+        for k in range(8)
+    ]
+
+    def affinity_payload(cid, i):
+        pre = preambles[cid % len(preambles)]
+        return {
+            "prompt": pre + f"Tài liệu {cid}-{i}: " + "nội dung riêng " * 8,
+            "cache_hint": pre,
+            "deadline_ms": deadline_s * 1000,
+        }
+
+    cache_kwargs = dict(
+        batch_overhead_s=0.02,
+        per_prompt_s=0.002,
+        per_token_s=args.per_token_s,
+        prefix_cache_blocks=4096,
+        cache_block_tokens=16,
+    )
+    aff_clients = 16
+    aff_per_client = max(6, args.per_client // 2)
+    aff = {}
+    for name, n in (("single", 1), ("fleet2", 2)):
+        loop, spread, hit, prompt = run_fleet(
+            n, cache_kwargs, aff_clients, aff_per_client, affinity_payload
+        )
+        aff[name] = {
+            "goodput_rps": loop["goodput_rps"],
+            "worker_requests": spread,
+            "cache_hit_tokens": hit,
+            "cache_hit_rate": round(hit / prompt, 4) if prompt else 0.0,
+        }
+    hit_ratio = (
+        round(aff["fleet2"]["cache_hit_rate"]
+              / aff["single"]["cache_hit_rate"], 3)
+        if aff["single"]["cache_hit_rate"] else float("inf")
+    )
+
+    return {
+        "workload": f"{clients} closed-loop clients x {args.per_client} "
+                    "requests, r04 mixed 1:1 short/long shape through the "
+                    "fleet router at 1 vs 2 workers; affinity arm: "
+                    f"{aff_clients} clients x {aff_per_client} over 8 "
+                    "cache_hint keys, 2 sticky workers vs 1 process",
+        **arms,
+        "goodput_scaling": scaling,
+        "affinity": {**aff, "hit_rate_ratio": hit_ratio},
+    }
+
+
 def qos_phase(args) -> dict:
     """Multi-tenant QoS under saturation (ISSUE 12 tentpole): the
     interactive tenant's ANCHORED TTFT p99 with a batch tenant saturating
@@ -1272,6 +1431,22 @@ def main(argv=None) -> int:
                    help="exit non-zero when 2-DP-replica goodput scales "
                         "below this ratio on the mixed workload (CI smoke "
                         "passes a softer floor for shared-runner jitter)")
+    # fleet phase knobs (front-door router over N engine workers)
+    p.add_argument("--fleet-batch-overhead-s", type=float, default=0.25,
+                   help="per-dispatch overhead charged by the fleet-phase "
+                        "workers; heavier than the single-process phases "
+                        "so worker capacity (not the router hop) is what "
+                        "saturates, making the 1-vs-2-worker scaling "
+                        "measure fan-out")
+    p.add_argument("--fleet-min-scaling", type=float, default=1.6,
+                   help="exit non-zero when 2-worker fleet goodput through "
+                        "the router scales below this ratio vs 1 worker "
+                        "(CI smoke passes a softer floor)")
+    p.add_argument("--fleet-min-affinity", type=float, default=0.9,
+                   help="exit non-zero when the 2-worker fleet's aggregate "
+                        "prefix-cache hit rate falls below this fraction "
+                        "of the single-process rate (cache-affinity "
+                        "routing must keep hint reuse sticky)")
     # QoS phase knobs (multi-tenant weighted-fair scheduling + preemption)
     p.add_argument("--qos-slots", type=int, default=4)
     p.add_argument("--qos-interactive-clients", type=int, default=4)
@@ -1305,7 +1480,7 @@ def main(argv=None) -> int:
                         "more than this percentage of goodput vs the "
                         "watchdog-less arm (CI smoke passes a softer floor "
                         "for shared-runner jitter)")
-    p.add_argument("--out", default="BENCH_serving_r10.json")
+    p.add_argument("--out", default="BENCH_serving_r11.json")
     p.add_argument("--min-speedup", type=float, default=4.0,
                    help="exit non-zero below this goodput ratio (CI smoke "
                         "passes a softer floor: shared 2-core runners get "
@@ -1431,6 +1606,10 @@ def main(argv=None) -> int:
     print("sharded phase ...", flush=True)
     sharded = sharded_phase(args)
 
+    # 8b) replica fleet: router fan-out scaling + cache-affinity routing
+    print("fleet phase ...", flush=True)
+    fleet = fleet_phase(args)
+
     # 9) multi-tenant QoS: interactive TTFT p99 under batch saturation
     print("qos phase ...", flush=True)
     qos = qos_phase(args)
@@ -1485,6 +1664,7 @@ def main(argv=None) -> int:
         "inflight": inflight,
         "journal": journal,
         "sharded": sharded,
+        "fleet": fleet,
         "qos": qos,
         "cancel": cancel,
         "slo": slo,
@@ -1538,6 +1718,15 @@ def main(argv=None) -> int:
         f"{sharded['dp1']['goodput_rps']} rps)"
     )
     print(
+        f"fleet: router goodput x{fleet['goodput_scaling']} at 2 workers "
+        f"({fleet['fleet2']['goodput_rps']} vs "
+        f"{fleet['fleet1']['goodput_rps']} rps); affinity hit-rate ratio "
+        f"{fleet['affinity']['hit_rate_ratio']} "
+        f"({fleet['affinity']['fleet2']['cache_hit_rate']} fleet vs "
+        f"{fleet['affinity']['single']['cache_hit_rate']} single, spread "
+        f"{fleet['affinity']['fleet2']['worker_requests']})"
+    )
+    print(
         f"qos: interactive TTFT p99 {qos['unloaded']['ttft_p99_s']}s "
         f"unloaded -> {qos['loaded']['ttft_p99_s']}s under batch "
         f"saturation ({qos['interactive_ttft_p99_degradation_pct']}% "
@@ -1578,6 +1767,10 @@ def main(argv=None) -> int:
         and journal["journal_overhead_pct"] <= args.journal_max_overhead_pct
         # multi-chip serving: 2 DP replicas must actually scale goodput
         and sharded["goodput_scaling"] >= args.sharded_min_scaling
+        # replica fleet: the front door must buy capacity at 2 workers and
+        # cache-affinity routing must keep shared-prefix reuse sticky
+        and fleet["goodput_scaling"] >= args.fleet_min_scaling
+        and fleet["affinity"]["hit_rate_ratio"] >= args.fleet_min_affinity
         # multi-tenant QoS: the interactive tail must hold under batch
         # saturation, and the preemption path must actually have fired
         # (a run that never preempted proved nothing)

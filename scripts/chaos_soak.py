@@ -49,9 +49,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from vnsum_tpu.backend.fake import FakeBackend  # noqa: E402
 from vnsum_tpu.core.artifacts import atomic_write_json  # noqa: E402
-from vnsum_tpu.serve.journal import RequestJournal  # noqa: E402
+from vnsum_tpu.serve.journal import RequestJournal, aggregate_status  # noqa: E402
 from vnsum_tpu.testing.chaos import (  # noqa: E402
     KillSchedule,
+    RouterProcess,
     ServerProcess,
     free_port,
     http_delete,
@@ -851,6 +852,195 @@ def hang_soak(args) -> int:
     return 0 if ok else 1
 
 
+# -- replica-fleet soak (--fleet): worker kills behind the router ------------
+
+
+def fleet_soak(args) -> int:
+    """Kill engine workers behind a live router and prove the FLEET ledger
+    invariant: the router journals every admitted request before dispatch,
+    so a SIGKILLed worker's unfinished ACCEPTs replay onto survivors —
+    0 requests lost, replays byte-identical, and the client never has to
+    know. The seeded schedule reuses the single-process kill shapes:
+    ``mid_load`` points SIGKILL the busiest worker; the first ``mid_drain``
+    point becomes a rolling drain-one-restart-one wave (the deploy path,
+    under the same load). Ends with a graceful SIGTERM of the ROUTER
+    (exit 0: drain, worker drains, journal seal) and an offline audit of
+    the router's journal against the deterministic reference outputs."""
+    fleet_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-fleet-")
+    own_dir = args.journal_dir is None
+    schedule = KillSchedule(args.seed, kills=args.kills,
+                            load_window_s=args.load_window_s)
+    print(f"fleet kill schedule (seed={args.seed}): "
+          f"{json.dumps(schedule.describe())}", flush=True)
+    worker_args = (
+        "--max-batch 4 --max-wait-ms 20 --drain-timeout-s 20 "
+        "--trace-sample 0 "
+        f"--fake-batch-overhead-ms {args.fake_batch_overhead_ms} "
+        f"--fake-per-prompt-ms {args.fake_per_prompt_ms}"
+    )
+    port = free_port()
+    router = RouterProcess(
+        port, fleet_dir=fleet_dir, spawn_workers=args.fleet_workers,
+        extra_args=["--probe-interval-ms", "100",
+                    "--worker-args", worker_args],
+    )
+    driver = LoadDriver(port, args.clients, args.per_client)
+    kills: list[str] = []
+    rolling_waves = 0
+    polled = 0
+    health: dict = {}
+
+    def fleet_health() -> dict:
+        _, payload = http_json("GET", "127.0.0.1", port, "/healthz",
+                               timeout=10)
+        return payload or {}
+
+    try:
+        router.start()
+        router.wait_ready(timeout_s=90)
+        driver.start()
+
+        for n, point in enumerate(schedule.points, start=1):
+            t_point = time.monotonic() + point.delay_s
+            while time.monotonic() < t_point:
+                time.sleep(0.05)
+            if point.kind == "mid_drain":
+                # the deploy path under load: drain-one-restart-one
+                print(f"[wave {n}] rolling restart under load", flush=True)
+                http_json("POST", "127.0.0.1", port,
+                          "/admin/rolling-restart", {}, timeout=10)
+                rolling_waves += 1
+                continue
+            live = [w for w in fleet_health().get("workers", [])
+                    if w.get("pid") and w.get("up")]
+            if not live:
+                time.sleep(0.2)
+                live = [w for w in fleet_health().get("workers", [])
+                        if w.get("pid") and w.get("up")]
+            if not live:
+                print(f"[kill {n}] skipped: no live worker", flush=True)
+                continue
+            victim = max(live, key=lambda w: w["inflight"])
+            print(f"[kill {n}] SIGKILL {victim['name']} "
+                  f"(pid {victim['pid']}, inflight {victim['inflight']}) "
+                  "mid-load", flush=True)
+            router.kill_worker(victim["name"])
+            kills.append(victim["name"])
+
+        # quiesce: load done, rolling wave finished, router ledger drained
+        t_end = time.monotonic() + args.quiesce_timeout_s
+        while time.monotonic() < t_end:
+            pending = scrape_metric(port, "vnsum_serve_journal_pending")
+            health = fleet_health()
+            if driver.done and pending == 0 and not health.get("rolling"):
+                break
+            time.sleep(0.2)
+        driver.stop()
+        health = fleet_health()
+        pending = scrape_metric(port, "vnsum_serve_journal_pending")
+        if pending != 0:
+            print(f"FAIL: router ledger never quiesced (pending={pending})")
+            return 1
+
+        # the reconnect surface survives worker deaths: ids a client saw
+        # complete poll back terminal off the ROUTER's global ledger
+        for rid in list(driver.completed)[:10]:
+            status, body = http_json(
+                "GET", "127.0.0.1", port, f"/v1/requests/{rid}", timeout=10,
+            )
+            if status != 200 or body["status"] != "completed":
+                print(f"FAIL: poll {rid}: {status} {body}")
+                return 1
+            polled += 1
+
+        # graceful exit: SIGTERM drains the front door, drains every
+        # worker (exit 0 each), seals the router journal, exits 0
+        router.sigterm()
+        rc = router.wait_exit(timeout_s=60)
+        if rc != 0:
+            print(f"FAIL: graceful router SIGTERM exited {rc}, not 0")
+            return 1
+    finally:
+        if router.alive:
+            router.sigkill()
+        driver.stop(timeout_s=5)
+
+    # -- offline audit of the ROUTER journal (read-only) -------------------
+    entries, sealed, torn = RequestJournal.read_state(
+        Path(fleet_dir) / "router"
+    )
+    lost = [e.rid for e in entries.values() if not e.terminal]
+    completed = [e for e in entries.values() if e.status == "complete"]
+    failed = [e for e in entries.values() if e.status == "failed"]
+    mismatches = [e.rid for e in completed
+                  if e.text != reference_output(e.payload)]
+    # retry-aware grouping (a shed-then-retried id journals rid, rid#1...):
+    # every id a client saw 200 for must aggregate completed AND carry the
+    # exact text the client received
+    groups: dict[str, list] = {}
+    for e in entries.values():
+        groups.setdefault(e.rid.split("#")[0], []).append(e)
+    client_vs_ledger = []
+    for rid, text in driver.completed.items():
+        group = groups.get(rid)
+        if group is None:
+            client_vs_ledger.append(rid)
+            continue
+        if aggregate_status(group) != "completed" or not any(
+            e.status == "complete" and e.text == text for e in group
+        ):
+            client_vs_ledger.append(rid)
+
+    workers_tbl = health.get("workers", [])
+    failovers = sum(w.get("failovers", 0) for w in workers_tbl)
+    restarts = sum(w.get("restarts", 0) for w in workers_tbl)
+    record = {
+        "bench": "chaos_soak_fleet_worker_kill",
+        "seed": args.seed,
+        "workers": args.fleet_workers,
+        "schedule": schedule.describe(),
+        "worker_kills": kills,
+        "rolling_waves": rolling_waves,
+        "worker_failovers": failovers,
+        "worker_restarts": restarts,
+        "sealed": sealed,
+        "torn_records_dropped": torn,
+        "journaled_accepts": len(entries),
+        "completed": len(completed),
+        "typed_failed": len(failed),
+        "lost": lost,
+        "replay_byte_mismatches": mismatches,
+        "client_vs_ledger_mismatches": client_vs_ledger,
+        "client_attempted": len(driver.attempted),
+        "client_saw_200": len(driver.completed),
+        "polled_after_kills": polled,
+        "router_sheds": health.get("sheds", {}),
+    }
+    print(json.dumps(record, indent=2, ensure_ascii=False))
+    if args.out:
+        atomic_write_json(args.out, record)
+        print(f"wrote {args.out}")
+    if own_dir:
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+
+    ok = (
+        not lost
+        and not mismatches
+        and not client_vs_ledger
+        and sealed
+        and len(entries) > 0
+        # the soak must actually exercise the failover machinery: at least
+        # one kill landed and at least one journaled request replayed (or
+        # retried inline) onto a survivor
+        and bool(kills)
+        and failovers + restarts > 0
+    )
+    print("fleet ledger invariant:", "OK" if ok else "VIOLATED")
+    print(f"kills={len(kills)} rolling_waves={rolling_waves} "
+          f"failovers={failovers} restarts={restarts}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--seed", type=int, default=7)
@@ -902,6 +1092,13 @@ def main(argv=None) -> int:
                    help="hang mode: allowed detection latency beyond the "
                         "configured budget/deadline (monitor runs at 10Hz; "
                         "this is host-scheduling headroom)")
+    p.add_argument("--fleet", action="store_true",
+                   help="replica-fleet mode: run a front-door router over "
+                        "N spawned engine workers, SIGKILL workers at the "
+                        "seeded points (plus one rolling-restart wave), "
+                        "and audit the ROUTER's global journal")
+    p.add_argument("--fleet-workers", type=int, default=3,
+                   help="engine workers behind the router in --fleet mode")
     p.add_argument("--out", default=None,
                    help="optional JSON artifact for the run record")
     args = p.parse_args(argv)
@@ -910,6 +1107,8 @@ def main(argv=None) -> int:
         return churn_soak(args)
     if args.hang:
         return hang_soak(args)
+    if args.fleet:
+        return fleet_soak(args)
 
     journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-chaos-")
     own_dir = args.journal_dir is None

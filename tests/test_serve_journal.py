@@ -442,3 +442,134 @@ def test_encode_lines_are_newline_framed():
     raw = _encode({"e": "accept", "rid": "x", "prompt": "có dấu ư"})
     assert raw.endswith(b"\n") and raw[8:9] == b" "
     assert b"\n" not in raw[:-1]  # one record, one line — framing invariant
+
+# -- inspection CLI (python -m vnsum_tpu.serve.journal) ----------------------
+
+
+def _sealed_fixture(tmp_path):
+    """A sealed journal with one of each fate: a COMPLETE, a typed FAIL,
+    and one unfinished ACCEPT (the handoff debt the CLI must surface)."""
+    j = RequestJournal(tmp_path)
+    done = j.accept(_req(prompt="đã xong " * 4, trace_id="cli-done"))
+    j.start(done)
+    j.complete(done, "kết quả", 3)
+    bad = j.accept(_req(prompt="hỏng " * 4, trace_id="cli-bad"))
+    j.fail(bad, "engine:boom", "giả lập")
+    j.accept(_req(prompt="dang dở " * 4, trace_id="cli-open"))
+    j.seal()
+    j.close()
+
+
+def test_journal_cli_dumps_sealed_fixture(tmp_path, capsys):
+    from vnsum_tpu.serve.journal import _main
+
+    _sealed_fixture(tmp_path)
+    assert _main([str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["sealed"] is True and out["torn_records"] == 0
+    assert out["entries"] == 3 and out["live"] == 1 and out["terminal"] == 2
+    assert out["by_status"] == {"complete": 1, "failed": 1, "accept": 1}
+    (open_,) = out["unfinished_accepts"]
+    assert open_["rid"] == "cli-open" and open_["status"] == "accept"
+    # the dumped payload is the full replayable ACCEPT record
+    assert open_["payload"]["prompt"].startswith("dang dở")
+    assert "max_new_tokens" in open_["payload"]
+
+
+def test_journal_cli_subprocess_and_bad_dir(tmp_path):
+    import subprocess
+    import sys
+
+    _sealed_fixture(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "vnsum_tpu.serve.journal", str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["live"] == 1
+    proc = subprocess.run(
+        [sys.executable, "-m", "vnsum_tpu.serve.journal",
+         str(tmp_path / "missing")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+    # last stderr line: runpy may prepend a sys.modules RuntimeWarning
+    err = json.loads(proc.stderr.strip().splitlines()[-1])
+    assert "not a directory" in err["error"]
+
+
+# -- cross-process journal handoff -------------------------------------------
+
+
+@pytest.mark.slow
+def test_cross_process_handoff_completes_byte_identically(tmp_path):
+    """The fleet failover invariant, minus the router: SIGKILL worker A
+    mid-flight, read its journal from the outside, re-dispatch every
+    unfinished ACCEPT onto an unrelated worker B over plain HTTP, and the
+    completions byte-match an uninterrupted run. This is exactly what
+    RouterState._handoff does — pinned here as a two-process protocol
+    test so a journal/payload schema drift fails loudly."""
+    from vnsum_tpu.serve.router import request_body_from_payload
+    from vnsum_tpu.testing.chaos import ServerProcess, http_json
+
+    dir_a = tmp_path / "worker-a"
+    dir_b = tmp_path / "worker-b"
+    a = ServerProcess(free_port(), journal_dir=str(dir_a),
+                      extra_args=["--fake-batch-overhead-ms", "3000"])
+    a.start()
+    prompts = [f"bản tin bị bỏ dở số {i} " * 4 for i in range(3)]
+    try:
+        a.wait_healthy()
+        threads = [
+            threading.Thread(
+                target=lambda p=p, i=i: http_json(
+                    "POST", "127.0.0.1", a.port, "/v1/generate",
+                    {"prompt": p, "request_id": f"handoff-{i}",
+                     "max_new_tokens": 16},
+                    timeout=30.0,
+                ),
+                daemon=True,
+            )
+            for i, p in enumerate(prompts)
+        ]
+        for t in threads:
+            t.start()
+        # let the ACCEPTs hit A's journal while the 3s batch overhead
+        # keeps every request non-terminal
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            entries, _, _ = RequestJournal.read_state(dir_a)
+            if len(entries) == len(prompts):
+                break
+            time.sleep(0.05)
+    finally:
+        a.sigkill()  # the crash under test: no drain, no seal
+
+    entries, sealed, _ = RequestJournal.read_state(dir_a)
+    assert sealed is False
+    unfinished = [e for e in entries.values() if not e.terminal]
+    assert len(unfinished) == len(prompts)
+
+    b = ServerProcess(free_port(), journal_dir=str(dir_b))
+    b.start()
+    try:
+        b.wait_healthy()
+        for e in unfinished:
+            path, body, headers = request_body_from_payload(e.rid, e.payload)
+            status, resp = http_json("POST", "127.0.0.1", b.port, path,
+                                     body, timeout=30.0)
+            assert status == 200, resp
+            text = resp["completions"][0]["text"]
+            # byte-identity against an uninterrupted in-process run of
+            # the SAME journaled payload
+            assert text == FakeBackend().generate(
+                [e.payload["prompt"]],
+                max_new_tokens=e.payload.get("max_new_tokens"),
+            )[0]
+        b.sigterm()
+        assert b.wait_exit(30.0) == 0  # graceful: drain + seal
+    finally:
+        if b.alive:
+            b.sigkill()
+    _, sealed_b, _ = RequestJournal.read_state(dir_b)
+    assert sealed_b is True

@@ -353,3 +353,75 @@ def test_single_chip_server_renders_no_mesh_gauges(serve_url):
     assert "mesh" not in json.loads(body)
     _, body = _get(base + "/metrics")
     assert "vnsum_serve_mesh_" not in body.decode()
+
+
+# -- /readyz: routability, distinct from /healthz liveness -------------------
+
+
+def _get_readyz(base):
+    try:
+        with urllib.request.urlopen(base + "/readyz", timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_readyz_ready_when_serving(serve_url):
+    base, _ = serve_url
+    status, body = _get_readyz(base)
+    assert status == 200 and body["status"] == "ready"
+
+
+def test_readyz_draining_is_typed_503(serve_url):
+    """A draining server still answers /healthz (alive) but /readyz must
+    say 503 draining — the router takes it out of rotation, not for dead."""
+    base, state = serve_url
+    state.scheduler.close()
+    status, body = _get_readyz(base)
+    assert status == 503
+    assert body["error"] == "not_ready" and body["reason"] == "draining"
+    # liveness stays answerable: the split IS the contract
+    status, _ = _get(base + "/healthz")
+    assert status == 200
+
+
+def test_readyz_brownout_is_typed_503(serve_url):
+    from types import SimpleNamespace
+
+    from vnsum_tpu.serve.supervisor import Rung
+
+    base, state = serve_url
+    saved = state.supervisor
+    state.supervisor = SimpleNamespace(rung=Rung.BROWNOUT)
+    try:
+        status, body = _get_readyz(base)
+        assert status == 503 and body["reason"] == "brownout"
+        state.supervisor = SimpleNamespace(rung=Rung.NO_SPEC)
+        status, body = _get_readyz(base)
+        assert status == 200  # any rung short of brownout stays routable
+    finally:
+        state.supervisor = saved
+
+
+def test_readyz_pre_replay_until_journal_replayed(tmp_path):
+    """A journal-armed server is NOT routable until startup replay has
+    re-enqueued its unfinished ACCEPTs — fresh traffic must not race
+    crash recovery. The standalone CLI replays before binding the port;
+    this pins the state machine the router's probe loop observes."""
+    state = ServeState(FakeBackend(), max_batch=4, max_wait_s=0.005,
+                       journal_dir=str(tmp_path))
+    server = make_server(state, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        status, body = _get_readyz(base)
+        assert status == 503 and body["reason"] == "pre_replay"
+        assert body["retry_after_s"] == 1.0
+        state.replay_journal()
+        status, body = _get_readyz(base)
+        assert status == 200 and body["status"] == "ready"
+    finally:
+        server.shutdown()
+        server.server_close()
+        state.close()

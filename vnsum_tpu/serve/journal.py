@@ -170,6 +170,12 @@ def request_payload(req) -> dict:
         payload["tenant"] = req.tenant
     if req.tier != "interactive":
         payload["tier"] = req.tier
+    # router-journaled summarize requests carry the strategy name so a
+    # handoff replays them through /v1/summarize, not /v1/generate; engine
+    # ServeRequests have no such attribute and stay byte-compatible
+    approach = getattr(req, "approach", None)
+    if approach:
+        payload["approach"] = approach
     return payload
 
 
@@ -659,5 +665,57 @@ def _apply(entries: OrderedDict, rec: dict) -> bool:
             entry.status = EV_CANCELLED
             entry.reason = str(rec.get("reason", "api"))
     return False
+
+
+# -- read-only inspection CLI -------------------------------------------------
+
+
+def _main(argv: list[str] | None = None) -> int:
+    """``python -m vnsum_tpu.serve.journal <dir>``: dump a journal
+    directory's ledger as JSON without opening it for writing — live /
+    terminal counts plus every unfinished ACCEPT with its full replayable
+    payload. The unfinished list is exactly what the router's
+    journal-handoff failover re-dispatches onto survivors, so this is the
+    handoff-debugging tool: point it at a dead worker's journal and see
+    what is owed."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m vnsum_tpu.serve.journal",
+        description="Read-only request-journal inspection (no writes, "
+                    "no compaction).",
+    )
+    parser.add_argument("directory", help="journal directory to read")
+    args = parser.parse_args(argv)
+    directory = Path(args.directory)
+    if not directory.is_dir():
+        print(json.dumps({"error": f"not a directory: {directory}"}),
+              file=sys.stderr)
+        return 2
+    entries, sealed, torn = RequestJournal.read_state(directory)
+    by_status: dict[str, int] = {}
+    unfinished = []
+    for entry in entries.values():
+        by_status[entry.status] = by_status.get(entry.status, 0) + 1
+        if not entry.terminal:
+            unfinished.append({"rid": entry.rid, "status": entry.status,
+                               "payload": entry.payload})
+    out = {
+        "directory": str(directory),
+        "sealed": sealed,
+        "torn_records": torn,
+        "entries": len(entries),
+        "live": len(unfinished),
+        "terminal": len(entries) - len(unfinished),
+        "by_status": by_status,
+        "unfinished_accepts": unfinished,
+    }
+    print(json.dumps(out, ensure_ascii=False, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())
 
 

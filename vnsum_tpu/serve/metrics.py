@@ -252,6 +252,30 @@ _reg("slot_occupancy", "histogram",
      "busy slots per in-flight decode segment")
 _reg("spec_accepted_per_step", "histogram",
      "accepted draft tokens per verify step, per request")
+# -- replica-fleet router (serve/router.py): the front-door process that
+# fans requests out to N engine workers. Rendered by RouterMetrics from the
+# same registry so the README doc-lint covers the fleet surface too
+_reg("router_workers", "gauge",
+     "engine workers configured behind the router")
+_reg("router_workers_up", "gauge",
+     "workers currently marked up (routable) by the probe loop")
+_reg("router_requests_total", "counter",
+     "requests proxied to each worker, by worker")
+_reg("router_failovers_total", "counter",
+     "journaled requests replayed onto survivors after a worker died or "
+     "sealed (exit 86), by source worker")
+_reg("router_markdowns_total", "counter",
+     "worker mark-down transitions (probe-failure / SLO-burn hysteresis), "
+     "by worker")
+_reg("router_markups_total", "counter",
+     "worker mark-up transitions (probes recovered), by worker")
+_reg("router_restarts_total", "counter",
+     "worker process restarts performed by the router (crash recovery + "
+     "rolling deploys), by worker")
+_reg("router_probe_seconds", "gauge",
+     "latency of the most recent readiness probe, by worker")
+_reg("router_sheds_total", "counter",
+     "requests shed at the router front door, by reason")
 
 
 def metric_names(full: bool = True) -> list[str]:

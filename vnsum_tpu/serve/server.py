@@ -190,6 +190,11 @@ class ServeState:
             self.journal = RequestJournal(
                 journal_dir, fsync_interval_s=journal_fsync_s
             )
+        # /readyz gate: a journal-armed server is not routable until
+        # startup replay has re-enqueued (or deadline-expired) every
+        # unfinished ACCEPT — the fleet router must not send fresh traffic
+        # ahead of crash recovery. Journal-less servers are ready at birth
+        self._replay_done = self.journal is None
         # fault tolerance (serve/supervisor.py): ON by default for the HTTP
         # front-end — engine failures are classified, survivors retried,
         # poison requests bisected out, and repeated resource failures step
@@ -435,7 +440,28 @@ class ServeState:
                                  seconds=round(time.monotonic() - t0, 6))
         if n:
             logger.info("journal replay: re-enqueued %d request(s)", n)
+        self._replay_done = True
         return n
+
+    def readiness(self) -> tuple[bool, str]:
+        """The ``/readyz`` verdict: (routable, reason). Distinct from
+        ``/healthz`` liveness — a draining, browned-out, or pre-replay
+        server is alive (healthz answers) but must not receive fresh
+        traffic, and the router's probe loop keys off exactly this split.
+        Reasons are typed: ``draining`` (shutdown drain underway, never
+        coming back), ``pre_replay`` (journal recovery still re-enqueuing
+        — route after replay), ``brownout`` (supervisor ladder bottomed
+        out — route again once the rung recovers)."""
+        if self.scheduler.closed:
+            return False, "draining"
+        if not self._replay_done:
+            return False, "pre_replay"
+        if self.supervisor is not None:
+            from .supervisor import Rung
+
+            if self.supervisor.rung >= Rung.BROWNOUT:
+                return False, "brownout"
+        return True, "ready"
 
     def cancel_request(self, rid: str) -> dict | None:
         """``DELETE /v1/requests/<id>`` — gang-cancel ``rid`` and its
@@ -696,6 +722,19 @@ def make_handler(state: ServeState):
                 self._usage(query)
             elif path.startswith("/v1/requests/"):
                 self._request_status(path[len("/v1/requests/"):])
+            elif path == "/readyz":
+                # routability, not liveness: typed 503 while draining,
+                # browned-out, or pre-replay so a router/LB can tell
+                # "alive but do not route" from dead (which never answers)
+                ready, reason = state.readiness()
+                if ready:
+                    self._json({"status": "ready"})
+                else:
+                    self._json(
+                        {"error": "not_ready", "reason": reason,
+                         "retry_after_s": 1.0},
+                        503, {"Retry-After": "1"},
+                    )
             elif path == "/healthz":
                 sup = state.supervisor
                 from .. import __version__
