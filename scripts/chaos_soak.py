@@ -1041,6 +1041,311 @@ def fleet_soak(args) -> int:
     return 0 if ok else 1
 
 
+# -- structured-jobs soak (--gang): SIGKILL mid-map-fan-out ------------------
+
+
+def make_doc(cid: int, i: int) -> str:
+    """A deterministic multi-chunk document: long enough that the mapreduce
+    splitter (chunk_size 12000 whitespace tokens) fans it out into several
+    map children plus a reduce — the gang shape the kills must land inside
+    of. Sizes vary per (cid, i) so fan-out widths differ across the run."""
+    nwords = 12600 + 700 * ((cid + i) % 3)
+    body = " ".join(_WORDS[(cid + i + k) % len(_WORDS)] for k in range(nwords))
+    return f"Tài liệu dài {cid}-{i}.\n\n{body}"
+
+
+def reference_summary(doc: str) -> str:
+    """The offline-barrier oracle for a whole structured job: the BLOCKING
+    MapReduceStrategy over a latency-free fake backend, with the server's
+    exact approach defaults. The serving path streams the same rounds
+    through the gang machinery — across kills and replays the final
+    summary a client sees must byte-match this."""
+    from vnsum_tpu.core.config import PipelineConfig, approach_defaults
+    from vnsum_tpu.strategies import get_strategy
+
+    cfg = PipelineConfig(approach="mapreduce",
+                         **approach_defaults("mapreduce"))
+    strat = get_strategy("mapreduce", FakeBackend(), cfg)
+    return strat.summarize_batch([doc])[0].summary
+
+
+class GangLoadDriver:
+    """Closed-loop summarize clients: each POST fans out server-side into a
+    gang of map children plus a reduce, all journaled under one trace id.
+    Robust to the server dying mid-fan-out — a client that never saw the
+    200 re-POSTs the same document under the same request_id, which rejoins
+    the (replay-restored) gang rather than forking a new one."""
+
+    def __init__(self, port: int, clients: int, per_client: int) -> None:
+        self.port = port
+        self.clients = clients
+        self.per_client = per_client
+        # docs are big (~13k words); build the deterministic stream once
+        self.docs = {
+            f"gang-{cid}-{i}": make_doc(cid, i)
+            for cid in range(clients) for i in range(per_client)
+        }
+        self.attempted: dict[str, str] = {}  # rid -> doc
+        self.completed: dict[str, str] = {}  # rid -> summary (HTTP 200 seen)
+        self.partials: set[str] = set()
+        self._lock = threading.Lock()
+        self._cursor = [0] * clients
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def _client(self, cid: int) -> None:
+        while not self._stop.is_set():
+            i = self._cursor[cid]
+            if i >= self.per_client:
+                return
+            rid = f"gang-{cid}-{i}"
+            doc = self.docs[rid]
+            with self._lock:
+                self.attempted[rid] = doc
+            try:
+                status, body = http_json(
+                    "POST", "127.0.0.1", self.port, "/v1/summarize",
+                    {"text": doc, "approach": "mapreduce",
+                     "request_id": rid},
+                    timeout=60.0,
+                )
+                if status == 200 and body and body.get("summary"):
+                    with self._lock:
+                        self.completed[rid] = body["summary"]
+                        if body.get("partial"):
+                            self.partials.add(rid)
+                    self._cursor[cid] = i + 1
+                elif status in (400, 404):
+                    self._cursor[cid] = i + 1  # don't spin on a client bug
+                else:
+                    time.sleep(0.05)  # shed/error: back off, retry same i
+            except OSError:
+                time.sleep(0.1)  # server is down/being killed: wait it out
+
+    def start(self) -> None:
+        self._threads = [
+            threading.Thread(target=self._client, args=(cid,), daemon=True)
+            for cid in range(self.clients)
+        ]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def done(self) -> bool:
+        return all(c >= self.per_client for c in self._cursor)
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        self._stop.set()
+        t_end = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(t_end - time.monotonic(), 0.1))
+
+
+def gang_soak(args) -> int:
+    """Structured-jobs chaos epoch: SIGKILL the server while gangs of
+    fanned-out map/reduce children are mid-flight, restart on the same
+    journal, and audit that every admitted gang folds to a TERMINAL parent
+    aggregate with byte-identical replays and no stranded cache pins.
+
+    Beyond the base ledger invariant this asserts, per gang:
+
+    - a typed GANG record exists and every recorded member is journaled
+      and terminal (membership never outlives the ledger);
+    - the parent aggregate (``rid`` plus its ``#N`` children folded by
+      ``aggregate_status``) is terminal for EVERY admitted gang — completed,
+      partial, failed, or cancelled, never stuck mid-lifecycle;
+    - every summary a client saw (HTTP 200) byte-matches the OFFLINE
+      blocking MapReduceStrategy over the same document — the streaming
+      reduce plus kills plus replay changed nothing observable;
+    - after quiesce ``vnsum_serve_cache_pinned_blocks`` reads 0: dead
+      gangs released every prefix-cache pin their fan-out took."""
+    journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-gangs-")
+    own_dir = args.journal_dir is None
+    schedule = KillSchedule(args.seed, kills=args.kills,
+                            load_window_s=args.load_window_s, qos=False)
+    print(f"gang kill schedule (seed={args.seed}): "
+          f"{json.dumps(schedule.describe())}", flush=True)
+
+    server_args = [
+        "--max-batch", "4",
+        "--max-wait-ms", "20",
+        "--drain-timeout-s", "20",
+        "--trace-sample", "0",
+        "--fake-batch-overhead-ms", str(args.fake_batch_overhead_ms),
+        "--fake-per-prompt-ms", str(args.fake_per_prompt_ms),
+    ]
+    port = free_port()
+    driver = GangLoadDriver(port, args.clients, args.per_client)
+    restarts = 0
+    srv = None
+    pinned = None
+    gang_admitted_final = None
+    try:
+        srv = ServerProcess(port, journal_dir=journal_dir,
+                            extra_args=server_args)
+        srv.start()
+        srv.wait_healthy()
+        driver.start()
+
+        for n, point in enumerate(schedule.points, start=1):
+            t_kill = time.monotonic() + point.delay_s
+            while time.monotonic() < t_kill:
+                time.sleep(0.05)
+            if point.kind == "mid_drain":
+                print(f"[kill {n}] SIGTERM, then SIGKILL "
+                      f"{point.drain_gap_s}s into the drain", flush=True)
+                srv.sigterm()
+                time.sleep(point.drain_gap_s)
+                srv.sigkill()
+            else:
+                print(f"[kill {n}] {point.kind}: SIGKILL after "
+                      f"{point.delay_s}s of load", flush=True)
+                srv.sigkill()
+            restarts += 1
+            srv = ServerProcess(port, journal_dir=journal_dir,
+                                extra_args=server_args)
+            srv.start()
+            srv.wait_healthy()
+
+        # let the surviving load finish, then wait for the ledger to
+        # quiesce — replayed gang children resolve through the same path
+        t_end = time.monotonic() + args.quiesce_timeout_s
+        while time.monotonic() < t_end:
+            pending = scrape_metric(port, "vnsum_serve_journal_pending")
+            if driver.done and pending == 0:
+                break
+            time.sleep(0.2)
+        driver.stop()
+        pending = scrape_metric(port, "vnsum_serve_journal_pending")
+        if pending != 0:
+            print(f"FAIL: journal never quiesced (pending={pending})")
+            return 1
+        # stranded-pin probe: with everything terminal, the prefix cache
+        # must hold zero pinned blocks — a gang that died mid-fan-out and
+        # left its template-header pins behind shows up RIGHT HERE
+        pinned = scrape_metric(port, "vnsum_serve_cache_pinned_blocks")
+        gang_admitted_final = scrape_metric(
+            port, "vnsum_serve_gang_admitted_total"
+        )
+
+        # reconnect surface: completed parents must poll back terminal
+        # WITH their per-phase gang progress attached
+        polled = 0
+        for rid in list(driver.completed)[:6]:
+            status, body = http_json(
+                "GET", "127.0.0.1", port, f"/v1/requests/{rid}", timeout=10,
+            )
+            assert status == 200 and body["status"] in (
+                "completed", "partial"
+            ), f"poll {rid}: {status} {body}"
+            gang = body.get("gang")
+            assert gang and "map" in gang.get("phases", {}), \
+                f"poll {rid}: no gang phase progress in {body}"
+            polled += 1
+
+        srv.sigterm()
+        rc = srv.wait_exit(timeout_s=30)
+        if rc != 0:
+            print(f"FAIL: graceful SIGTERM shutdown exited {rc}, not 0")
+            return 1
+        srv = None
+    finally:
+        if srv is not None and srv.alive:
+            srv.sigkill()
+        driver.stop(timeout_s=5)
+
+    # -- offline ledger + gang audit (read-only) ---------------------------
+    entries, sealed, torn = RequestJournal.read_state(journal_dir)
+    lost = [e.rid for e in entries.values() if not e.terminal]
+    completed = [e for e in entries.values() if e.status == "complete"]
+    failed = [e for e in entries.values() if e.status == "failed"]
+    mismatches = [e.rid for e in completed
+                  if e.text != reference_output(e.payload)]
+
+    # parent aggregates: fold each trace's children; every admitted gang
+    # must land on a terminal fold, whatever the kills did to it
+    groups: dict[str, list] = {}
+    for e in entries.values():
+        groups.setdefault(e.rid.split("#")[0], []).append(e)
+    terminal = {"completed", "partial", "failed", "cancelled"}
+    parent_status = {base: aggregate_status(g) for base, g in groups.items()}
+    stuck_parents = sorted(
+        b for b, s in parent_status.items() if s not in terminal
+    )
+
+    # gang membership: every member a GANG record names must be journaled
+    # and terminal, and every parent trace must carry a GANG record
+    gangs = RequestJournal.read_gangs(journal_dir)
+    member_gaps = sorted(
+        rid
+        for g in gangs.values()
+        for rid in g["members"]
+        if rid not in entries or not entries[rid].terminal
+    )
+    unrecorded_parents = sorted(b for b in groups if b not in gangs)
+
+    # end-to-end byte identity: streaming + kills + replay vs the offline
+    # blocking strategy, per document a client actually saw complete
+    summary_mismatches = [
+        rid for rid, text in driver.completed.items()
+        if text != reference_summary(driver.docs[rid])
+    ]
+
+    record = {
+        "bench": "chaos_soak_gang_kill",
+        "seed": args.seed,
+        "schedule": schedule.describe(),
+        "restarts": restarts,
+        "sealed": sealed,
+        "torn_records_dropped": torn,
+        "journaled_accepts": len(entries),
+        "completed": len(completed),
+        "typed_failed": len(failed),
+        "lost": lost,
+        "replay_byte_mismatches": mismatches,
+        "gangs_recorded": len(gangs),
+        "gang_members_recorded": sum(len(g["members"])
+                                     for g in gangs.values()),
+        "gang_admitted_final_epoch": gang_admitted_final,
+        "parent_aggregates": {
+            s: sum(1 for v in parent_status.values() if v == s)
+            for s in sorted(set(parent_status.values()))
+        },
+        "stuck_parents": stuck_parents,
+        "gang_member_gaps": member_gaps,
+        "unrecorded_parents": unrecorded_parents,
+        "summary_byte_mismatches": summary_mismatches,
+        "client_partials": sorted(driver.partials),
+        "cache_pinned_blocks_after_quiesce": pinned,
+        "client_attempted": len(driver.attempted),
+        "client_saw_200": len(driver.completed),
+        "polled_after_restart": polled,
+    }
+    print(json.dumps(record, indent=2, ensure_ascii=False))
+    if args.out:
+        atomic_write_json(args.out, record)
+        print(f"wrote {args.out}")
+    if own_dir:
+        shutil.rmtree(journal_dir, ignore_errors=True)
+
+    ok = (
+        not lost
+        and not mismatches
+        and not summary_mismatches
+        and not stuck_parents
+        and not member_gaps
+        and not unrecorded_parents
+        and sealed
+        and len(entries) > 0
+        and len(gangs) > 0
+        and pinned == 0
+    )
+    print("gang ledger invariant:", "OK" if ok else "VIOLATED")
+    print(f"gangs={len(gangs)} parents={len(groups)} "
+          f"children={len(entries)} pinned_after={pinned}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--seed", type=int, default=7)
@@ -1099,6 +1404,12 @@ def main(argv=None) -> int:
                         "and audit the ROUTER's global journal")
     p.add_argument("--fleet-workers", type=int, default=3,
                    help="engine workers behind the router in --fleet mode")
+    p.add_argument("--gang", action="store_true",
+                   help="structured-jobs mode: drive /v1/summarize fan-outs "
+                        "(gangs of map children plus a streaming reduce), "
+                        "SIGKILL mid-fan-out, and audit that every admitted "
+                        "gang folds to a terminal parent aggregate with "
+                        "byte-identical replays and zero stranded cache pins")
     p.add_argument("--out", default=None,
                    help="optional JSON artifact for the run record")
     args = p.parse_args(argv)
@@ -1109,6 +1420,8 @@ def main(argv=None) -> int:
         return hang_soak(args)
     if args.fleet:
         return fleet_soak(args)
+    if args.gang:
+        return gang_soak(args)
 
     journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="vnsum-chaos-")
     own_dir = args.journal_dir is None

@@ -40,7 +40,7 @@ def main() -> int:
     ap.add_argument(
         "--approaches",
         default="mapreduce,truncated,iterative,mapreduce_hierarchical,"
-                "mapreduce_critique",
+                "mapreduce_critique,skeleton",
     )
     ap.add_argument("--engine-batch", type=int, default=0,
                     help="override e2e engine batch_size (0 = default)")

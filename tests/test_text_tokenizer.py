@@ -27,6 +27,7 @@ def test_template_headers_are_string_prefixes():
         "original_chunks": content, "current_summary": content,
         "critique": content, "reference_content": content,
         "context": content, "existing_answer": content, "text": content,
+        "point": content,
     }
     for name, (tpl, head) in templates.items():
         assert tpl.format(**{

@@ -18,6 +18,7 @@ ApproachName = Literal[
     "iterative",
     "truncated",
     "mapreduce_hierarchical",
+    "skeleton",
 ]
 
 APPROACHES: tuple[str, ...] = (
@@ -26,6 +27,7 @@ APPROACHES: tuple[str, ...] = (
     "iterative",
     "truncated",
     "mapreduce_hierarchical",
+    "skeleton",
 )
 
 
@@ -262,4 +264,8 @@ def approach_defaults(approach: str) -> dict:
         }
     if approach == "mapreduce_hierarchical":
         return {"chunk_size": 12000, "chunk_overlap": 200, "max_depth": 1}
+    if approach == "skeleton":
+        # Skeleton-of-Thought (arXiv 2307.15337): same context contract as
+        # truncated — the outline/expand fan-out runs over what fits
+        return {"max_context": 16384}
     raise ValueError(f"unknown approach: {approach}")

@@ -194,6 +194,15 @@ class ServingStats:
     stream_coalesced: int = 0
     stream_resumes: int = 0
     stream_heartbeats: int = 0
+    # structured jobs (serve/gang.py): gangs admitted through the one-pass
+    # request-level gate, fan-out children recorded into groups, take-path
+    # batches where the affinity pick co-scheduled siblings, whole-gang
+    # slot evictions, and gangs degraded to a partial result
+    gang_admitted: int = 0
+    gang_members: int = 0
+    gang_affinity_picks: int = 0
+    gang_preemptions: int = 0
+    gang_partials: int = 0
 
     @property
     def shed_total(self) -> int:

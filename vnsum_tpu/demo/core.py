@@ -1,4 +1,4 @@
-"""Demo core: run the five approaches side-by-side over one document and
+"""Demo core: run the registered approaches side-by-side over one document and
 score each against an optional reference — the compute behind both demo
 frontends (web server + streamlit), mirroring the reference's
 streamlit_demo.py:61-161 (_summarise_async dispatch + compute_metrics).

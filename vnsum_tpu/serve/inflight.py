@@ -384,29 +384,53 @@ class InflightScheduler(MicroBatchScheduler):
         burst reclaims the engine within one segment.
 
         Two demand signals: (a) queued interactive requests COMPATIBLE with
-        the resident key — evict exactly that many (bounded by the victims
+        the resident key — evict at least that many (bounded by the victims
         available); (b) an INCOMPATIBLE interactive head older than
         switch_grace_s — evict every batch resident so the loop drains and
         rebuilds for the new key instead of making the head wait out a
         long batch decode. Victims are chosen youngest-first (least decode
         work lost), each capped at ``preempt_budget`` lifetime evictions so
         sustained interactive pressure delays batch work but never starves
-        it."""
+        it.
+
+        Gang granularity (serve/gang.py): residents of one structured job
+        are evicted WHOLE or not at all — a half-evicted fan-out strands
+        the survivors' reduce behind a requeued sibling while the evictees
+        hold prefix pins, the worst of both. Whole-gang eviction also bills
+        the preempt budget per GANG: every member's counter moves in
+        lockstep, and a gang with ANY member at budget is wholly
+        non-evictable (the budget's starvation bound holds for the group
+        exactly as it does for a lone request). Demand may be exceeded by
+        gang granularity — deliberately. Ungrouped residents behave exactly
+        as before."""
         if loop is None or not loop.active or self.queue.tenants is None:
             return
-        victims = [
-            r for r in loop.outstanding()
-            if getattr(r, "tier", "") == "batch"
-            and r.preemptions < self.preempt_budget
+
+        def evictable(r: ServeRequest) -> bool:
             # greedy only: a restart recomputes byte-identically, which is
             # the losslessness contract. A SAMPLED row's stream keys on its
             # slot-admission uid — re-admission would draw a different
             # stream, so sampled batch requests keep their slots
-            and (r.config is None
-                 or getattr(r.config, "temperature", 0.0) == 0.0)
+            return r.preemptions < self.preempt_budget and (
+                r.config is None
+                or getattr(r.config, "temperature", 0.0) == 0.0
+            )
+
+        # group batch-tier residents by gang (ungrouped rows are their own
+        # singleton group); a group is evictable only when EVERY member is
+        groups: dict[str, list[ServeRequest]] = {}
+        for i, r in enumerate(loop.outstanding()):
+            if getattr(r, "tier", "") != "batch":
+                continue
+            gid = getattr(r, "gang_id", "") or f"solo#{i}"
+            groups.setdefault(gid, []).append(r)
+        evictable_groups = [
+            (gid, members) for gid, members in groups.items()
+            if all(evictable(r) for r in members)
         ]
-        if not victims:
+        if not evictable_groups:
             return
+        n_victims = sum(len(m) for _, m in evictable_groups)
         demand = 0
         if not loop.free:
             demand = self.queue.waiting_interactive(loop_key)
@@ -419,18 +443,32 @@ class InflightScheduler(MicroBatchScheduler):
         ):
             # incompatible interactive head past grace: full drain — every
             # batch resident goes, the loop rebuilds for the new key
-            demand = len(victims)
+            demand = n_victims
         if demand <= 0:
             return
+
         # youngest-first: outstanding() is slot order; admission order is
         # tracked per-slot, so sort by admit time (newest residents lose
-        # the least completed decode work)
+        # the least completed decode work). A GROUP's age is its youngest
+        # member's — evicting the gang that joined last loses the least
         def admitted_at(r):
             adm = getattr(r, "inflight_admission", None)
             return adm.admitted_at if adm is not None else 0.0
 
-        victims.sort(key=admitted_at, reverse=True)
-        evictions = loop.evict(victims[: min(demand, len(victims))])
+        evictable_groups.sort(
+            key=lambda g: max(admitted_at(r) for r in g[1]), reverse=True,
+        )
+        chosen: list[ServeRequest] = []
+        gang_ids: list[str] = []
+        for gid, members in evictable_groups:
+            if len(chosen) >= demand:
+                break
+            chosen.extend(
+                sorted(members, key=admitted_at, reverse=True)
+            )
+            if not gid.startswith("solo#"):
+                gang_ids.append(gid)
+        evictions = loop.evict(chosen)
         if not evictions:
             return
         if self._preempt_gap_s:
@@ -439,9 +477,13 @@ class InflightScheduler(MicroBatchScheduler):
             time.sleep(self._preempt_gap_s)
         for ev in evictions:
             self._requeue_eviction(ev)
+        for gid in gang_ids:
+            self.gangs.note_preemption(gid)
         logger.info(
-            "preempted %d batch-tier resident(s) for interactive demand",
+            "preempted %d batch-tier resident(s) for interactive demand"
+            "%s",
             len(evictions),
+            f" ({len(gang_ids)} whole gang(s))" if gang_ids else "",
         )
 
     def _requeue_eviction(self, ev) -> None:

@@ -83,6 +83,14 @@ EV_CANCELLED = "cancelled"
 EV_PREEMPT = "preempted"
 EV_REQUEUE = "requeued"
 EV_STREAM = "streaming"
+# structured jobs (serve/gang.py): a GANG record is group METADATA, not a
+# request lifecycle event — ``rid`` is the gang id and ``members`` lists
+# (child_rid, phase) pairs admitted since the last flush, so restart replay
+# reconstructs group membership (and the /v1/requests per-phase progress
+# view) without inferring it from rid prefixes. A GANG record with
+# ``partial: true`` marks the group degraded: a member failed typed POISON
+# and the reduce proceeded without it
+EV_GANG = "gang"
 
 # the non-terminal lifecycle states compaction must preserve (a preempted
 # entry that compacts to a bare ACCEPT would lie to GET /v1/requests/<id>)
@@ -170,6 +178,15 @@ def request_payload(req) -> dict:
         payload["tenant"] = req.tenant
     if req.tier != "interactive":
         payload["tier"] = req.tier
+    # structured-job membership survives restart: a replayed gang member
+    # must rejoin its group (affinity pick, whole-gang preemption, per-phase
+    # progress) instead of replaying as an unrelated request (omitted when
+    # ungrouped so old journals stay byte-compatible)
+    gang_id = getattr(req, "gang_id", "")
+    if gang_id:
+        payload["gang"] = gang_id
+        if getattr(req, "gang_phase", ""):
+            payload["gang_phase"] = req.gang_phase
     # router-journaled summarize requests carry the strategy name so a
     # handoff replays them through /v1/summarize, not /v1/generate; engine
     # ServeRequests have no such attribute and stay byte-compatible
@@ -224,8 +241,12 @@ class RequestJournal:
         self.replay_seconds = 0.0
         self.recovered_sealed = False
 
-        state, seq, sealed, torn = _read_directory(self.directory)
+        state, seq, sealed, torn, gangs = _read_directory(self.directory)
         self._entries = state
+        # structured-job group metadata (serve/gang.py), rebuilt from GANG
+        # records at recovery: {gang_id: {"members": {rid: phase},
+        # "partial": bool}}            # guarded by: _lock
+        self._gangs = gangs
         # running count of terminal entries so completion-path eviction is
         # O(1) except when actually evicting     # guarded by: _lock
         self._terminal = sum(1 for e in state.values() if e.terminal)
@@ -281,6 +302,19 @@ class RequestJournal:
                     # honest across a compacting reopen; the entry still
                     # replays from its ACCEPT payload either way
                     f.write(_encode({"e": entry.status, "rid": entry.rid}))
+            # structured-job metadata rides compaction too: a gang whose
+            # members were all evicted has nothing left to describe — drop
+            # it so gang metadata is bounded by live history like entries
+            self._gangs = {
+                gid: meta for gid, meta in self._gangs.items()
+                if any(r in self._entries for r in meta["members"])
+            }
+            for gid, meta in self._gangs.items():
+                rec = {"e": EV_GANG, "rid": gid,
+                       "members": [[r, p] for r, p in meta["members"].items()]}
+                if meta.get("partial"):
+                    rec["partial"] = True
+                f.write(_encode(rec))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -403,6 +437,69 @@ class RequestJournal:
         """First SSE delta left the server for this request."""
         with self._lock:
             self._lifecycle_locked(rid, EV_STREAM)
+
+    def gang(self, gang_id: str, members: list[tuple[str, str]]) -> None:
+        """Journal one structured-job membership flush (serve/gang.py):
+        ``members`` is the (child_rid, phase) batch admitted since the last
+        flush — one record per fan-out round, not per member, so a 40-chunk
+        map round costs one append. Idempotent per member (replay-safe:
+        a re-flushed member just overwrites its phase)."""
+        if not members:
+            return
+        with self._lock:
+            meta = self._gangs.setdefault(
+                gang_id, {"members": {}, "partial": False}
+            )
+            meta["members"].update(members)
+            self._append_locked(
+                {"e": EV_GANG, "rid": gang_id,
+                 "members": [[r, p] for r, p in members]},
+                allow_sync=True,
+            )
+
+    def gang_partial(self, gang_id: str, reason: str = "poison") -> None:
+        """Mark a gang DEGRADED: a member failed typed POISON and the reduce
+        proceeded without its output. Journaled so a restarted server's
+        /v1/requests view still distinguishes a degraded summary from a
+        complete one. Idempotent."""
+        with self._lock:
+            meta = self._gangs.setdefault(
+                gang_id, {"members": {}, "partial": False}
+            )
+            if meta["partial"]:
+                return
+            meta["partial"] = True
+            self._append_locked(
+                {"e": EV_GANG, "rid": gang_id, "partial": True,
+                 "reason": reason},
+                allow_sync=True,
+            )
+
+    def gang_info(self, gang_id: str) -> dict | None:
+        """Group metadata for the poll surface: {"members": {rid: phase},
+        "partial": bool} or None when the id never flushed a gang."""
+        with self._lock:
+            meta = self._gangs.get(gang_id)
+            if meta is None:
+                return None
+            return {"members": dict(meta["members"]),
+                    "partial": bool(meta["partial"])}
+
+    def gangs_unfinished(self) -> dict[str, dict]:
+        """Gangs with at least one non-terminal member — what startup
+        replay hands the GangRegistry so replayed members rejoin their
+        groups."""
+        with self._lock:
+            out = {}
+            for gid, meta in self._gangs.items():
+                live = any(
+                    (e := self._entries.get(r)) is not None and not e.terminal
+                    for r in meta["members"]
+                )
+                if live:
+                    out[gid] = {"members": dict(meta["members"]),
+                                "partial": bool(meta["partial"])}
+            return out
 
     def complete(self, rid: str, text: str, gen_tokens: int = 0) -> None:
         with self._lock:
@@ -536,8 +633,19 @@ class RequestJournal:
         """Read-only ledger view: (entries, sealed, torn_records) without
         opening the journal for writing or compacting — what the chaos-soak
         harness audits after the final shutdown."""
-        entries, _seq, sealed, torn = _read_directory(Path(directory))
+        entries, _seq, sealed, torn, _gangs = _read_directory(Path(directory))
         return entries, sealed, torn
+
+    @staticmethod
+    def read_gangs(directory: str | Path) -> dict[str, dict]:
+        """Read-only structured-job view: {gang_id: {"members":
+        {rid: phase}, "partial": bool}} — the chaos-soak gang audit's
+        membership source (every admitted gang must fold to a terminal
+        parent aggregate)."""
+        _entries, _seq, _sealed, _torn, gangs = _read_directory(
+            Path(directory)
+        )
+        return gangs
 
 
 def aggregate_status(entries: list[JournalEntry]) -> str:
@@ -561,6 +669,19 @@ def aggregate_status(entries: list[JournalEntry]) -> str:
     if same_payload and EV_COMPLETE in statuses:
         return "completed"
     if EV_FAILED in statuses:
+        if (
+            not same_payload
+            and EV_COMPLETE in statuses
+            and all(e.terminal for e in entries)
+        ):
+            # degraded fan-out (serve/gang.py): a member failed typed
+            # POISON but the gang delivered a reduce over the survivors —
+            # terminal, yet the client must be able to tell this summary
+            # from a complete one. Gated on all-terminal: while siblings
+            # are still moving the fold keeps reporting "failed" (the
+            # pre-gang contract) and flips to "partial" only once the
+            # degraded result actually exists
+            return "partial"
         return "failed"
     if statuses == {EV_COMPLETE}:
         return "completed"
@@ -599,13 +720,15 @@ def _segment_paths(directory: Path) -> list[Path]:
 
 
 def _read_directory(directory: Path):
-    """Replay every segment -> (entries, max_seq, sealed, torn_records).
+    """Replay every segment -> (entries, max_seq, sealed, torn_records,
+    gangs).
 
     A record that fails CRC/decode stops the read of ITS segment (everything
     after an unverifiable record is untrusted), which covers the torn-tail
     case a kill mid-append leaves; earlier records and later segments are
     unaffected."""
     entries: OrderedDict[str, JournalEntry] = OrderedDict()
+    gangs: dict[str, dict] = {}
     max_seq = 0
     sealed = False
     torn = 0
@@ -626,11 +749,11 @@ def _read_directory(directory: Path):
                     "rest of the segment)", path.name,
                 )
                 break
-            sealed = _apply(entries, rec)
-    return entries, max_seq, sealed, torn
+            sealed = _apply(entries, rec, gangs)
+    return entries, max_seq, sealed, torn, gangs
 
 
-def _apply(entries: OrderedDict, rec: dict) -> bool:
+def _apply(entries: OrderedDict, rec: dict, gangs: dict | None = None) -> bool:
     """Fold one record into the state map; returns the new sealed flag
     (True only when THIS record is a seal — any later record unseals)."""
     ev = rec.get("e")
@@ -638,6 +761,15 @@ def _apply(entries: OrderedDict, rec: dict) -> bool:
         return True
     rid = rec.get("rid")
     if not isinstance(rid, str):
+        return False
+    if ev == EV_GANG:
+        if gangs is not None:
+            meta = gangs.setdefault(rid, {"members": {}, "partial": False})
+            for pair in rec.get("members") or []:
+                if isinstance(pair, list) and len(pair) == 2:
+                    meta["members"][str(pair[0])] = str(pair[1])
+            if rec.get("partial"):
+                meta["partial"] = True
         return False
     if ev == EV_ACCEPT:
         if rid not in entries:

@@ -130,6 +130,23 @@ _reg("qos_preemptions_total", "counter",
      "batch-tier slot evictions performed for interactive work")
 _reg("qos_requeues_total", "counter",
      "preempted requests re-admitted through the queue")
+# -- structured jobs (serve/gang.py): gang-scheduled fan-out
+_reg("gang_admitted_total", "counter",
+     "structured jobs (gangs) admitted — one per fan-out request through "
+     "the request-level admission gate")
+_reg("gang_members_total", "counter",
+     "fan-out children recorded into gang groups")
+_reg("gang_affinity_picks_total", "counter",
+     "take-path batches where the gang-affinity pick co-scheduled two or "
+     "more siblings of one gang into the same generation")
+_reg("gang_preemptions_total", "counter",
+     "whole-gang slot evictions (group-granular QoS preemption — a gang "
+     "is never half-evicted)")
+_reg("gang_partial_total", "counter",
+     "gangs degraded to a partial result (a POISON member was dropped "
+     "from the reduce)")
+_reg("gang_active", "gauge",
+     "live structured-job groups in the gang registry (scrape-time)")
 _reg("stream_requests_total", "counter",
      "requests served with SSE streaming (stream=true)")
 _reg("stream_events_total", "counter",
@@ -444,6 +461,30 @@ class ServeMetrics:
             if self.usage is not None:
                 self.usage.observe_requeue(tenant, n)
 
+    # -- structured jobs (serve/gang.py) ----------------------------------
+
+    def observe_gang_admitted(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.gang_admitted += n
+
+    def observe_gang_members(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.gang_members += n
+
+    def observe_gang_affinity_pick(self, n: int = 1) -> None:
+        """One take-path batch in which the affinity pick co-scheduled >=2
+        siblings of a gang (counted once per gang per batch)."""
+        with self._lock:
+            self._stats.gang_affinity_picks += n
+
+    def observe_gang_preemption(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.gang_preemptions += n
+
+    def observe_gang_partial(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats.gang_partials += n
+
     def observe_stream_request(self, n: int = 1) -> None:
         with self._lock:
             self._stats.stream_requests += n
@@ -611,6 +652,7 @@ class ServeMetrics:
                           journal_stats: dict | None = None,
                           mesh_state: dict | None = None,
                           qos_state: dict | None = None,
+                          gang_state: dict | None = None,
                           slo_state: dict | None = None,
                           recorder_stats: dict | None = None,
                           watchdog_stats: dict | None = None,
@@ -718,6 +760,15 @@ class ServeMetrics:
         simple("degraded_recoveries_total", s.degraded_recoveries)
         simple("qos_preemptions_total", s.preemptions)
         simple("qos_requeues_total", s.requeues)
+        simple("gang_admitted_total", s.gang_admitted)
+        simple("gang_members_total", s.gang_members)
+        simple("gang_affinity_picks_total", s.gang_affinity_picks)
+        simple("gang_preemptions_total", s.gang_preemptions)
+        simple("gang_partial_total", s.gang_partials)
+        if gang_state is not None:
+            # read from the live GangRegistry at scrape time, like the
+            # queue gauges — the metrics layer never mirrors group state
+            simple("gang_active", gang_state.get("active", 0))
         simple("stream_requests_total", s.stream_requests)
         simple("stream_events_total", s.stream_events)
         simple("stream_active", s.streams_open)

@@ -139,6 +139,17 @@ class ServeRequest:
     # ACCEPT state
     tenant: str = ""
     tier: str = "interactive"
+    # structured jobs (serve/gang.py): the gang this row belongs to ("" =
+    # ungrouped). Fan-out siblings of one summarize/skeleton request share
+    # it; the queue's take paths cluster same-gang rows into one slot
+    # generation (so they share the template-header prefix in the radix
+    # cache) and the in-flight preemption path evicts whole gangs. Per-ROW
+    # metadata, never part of batch_key
+    gang_id: str = ""
+    # which phase of the structured job this row serves ("map" / "reduce" /
+    # "outline" / "expand" / "" for ungrouped) — journal + /v1/requests
+    # per-phase progress metadata only, never scheduling policy
+    gang_phase: str = ""
     # streaming (serve/stream.py): the per-request emit channel the
     # scheduler pushes decode-progress text into (None = non-streaming).
     # Never compared/printed — it carries a live Queue
@@ -214,6 +225,17 @@ class RequestQueue:
         # request: counting the admit here means no scrape window where a
         # request is completed but not yet counted as submitted
         self.on_admit = None  # callable(req) | None — metrics hook
+        # called under the queue lock with each taken batch (the commit
+        # point) — the gang-affinity observability hook (serve/gang.py):
+        # the scheduler counts multi-row takes that landed one gang
+        # together. Must be cheap and lock-free like on_admit
+        self.on_take = None  # callable(list[req]) | None — metrics hook
+        # gang-affinity pick (serve/gang.py): when an over-full take must
+        # choose, cluster the head's gang first so fan-out siblings ride
+        # one slot generation and share their template-header prefix in
+        # the radix cache. False = the pre-gang cache-hint clustering only
+        # (the bench A/B's off arm)
+        self.gang_affinity = True
         # supervisor brownout gate (serve/supervisor.py::admission_gate):
         # callable() -> Retry-After seconds when the degradation ladder is
         # shedding new work, None when admitting. Consulted for EXTERNAL
@@ -352,9 +374,25 @@ class RequestQueue:
         (The multi-tenant WFQ pick lives in ``_take_locked``, not here:
         this method also runs speculatively from the wait loops, and the
         deficit-round-robin state must only be charged for requests that
-        are actually taken.)"""
+        are actually taken.)
+
+        Gang affinity (serve/gang.py) outranks cache-hint clustering when
+        the head row belongs to a gang: siblings of one structured job
+        share the SAME template-header hint by construction, so keeping
+        the gang together is the strictly stronger form of the same
+        cache argument — and it additionally keeps the whole fan-out in
+        one slot generation for group-aware preemption. Ungrouped heads
+        fall through to the pre-gang behavior byte for byte."""
         compat = [r for r in self._items if r.batch_key() == key]
-        if len(compat) > max_take and any(r.cache_hint for r in compat):
+        if len(compat) <= max_take:
+            return compat
+        if self.gang_affinity and compat[0].gang_id:
+            gang = compat[0].gang_id
+            compat = (
+                [r for r in compat if r.gang_id == gang]
+                + [r for r in compat if r.gang_id != gang]
+            )
+        elif any(r.cache_hint for r in compat):
             hint = compat[0].cache_hint
             compat = (
                 [r for r in compat if r.cache_hint == hint]
@@ -388,6 +426,8 @@ class RequestQueue:
         self._items = [r for r in self._items if id(r) not in taken]
         for r in batch:
             self._queued_tokens -= r.billable_tokens
+        if self.on_take is not None and batch:
+            self.on_take(batch)
         return batch
 
     def take_batch(self, max_batch: int, max_wait_s: float) -> list[ServeRequest] | None:

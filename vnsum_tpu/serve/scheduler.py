@@ -155,6 +155,14 @@ class MicroBatchScheduler:
         )
         self.queue.on_shed = self._on_shed
         self.queue.on_admit = self._on_admit
+        self.queue.on_take = self._on_take
+        # structured jobs (serve/gang.py): gang admission, membership
+        # journaling, and degraded-result marking. Always constructed —
+        # gang bookkeeping is part of the serving contract; the bench A/B
+        # toggles only queue.gang_affinity
+        from .gang import GangRegistry
+
+        self.gangs = GangRegistry(journal=journal, metrics=self.metrics)
         if supervisor is not None:
             # brownout gate: at the ladder's bottom rung new EXTERNAL
             # admissions shed with a typed 503 + Retry-After; the gate call
@@ -221,6 +229,8 @@ class MicroBatchScheduler:
         tenant: str = "",
         tier: str = "interactive",
         stream=None,
+        gang: str = "",
+        gang_phase: str = "",
     ):
         """Admit one prompt; returns a Future resolving to a _Completion.
         Raises RequestShed synchronously when admission control rejects.
@@ -256,7 +266,13 @@ class MicroBatchScheduler:
         bills the token-rate quota and shares via the weighted-fair pick;
         tier "batch" marks the request preemptible in in-flight mode.
         ``stream`` is a serve/stream.StreamChannel the scheduler pushes
-        decode-progress text into (the HTTP layer's SSE source)."""
+        decode-progress text into (the HTTP layer's SSE source).
+
+        ``gang``/``gang_phase`` mark this prompt a member of a structured
+        job (serve/gang.py): the queue's take paths cluster same-gang rows
+        into one slot generation, the preemption path evicts the group
+        whole, and the member joins its gang's journal record at the next
+        round flush."""
         req = ServeRequest(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -270,6 +286,8 @@ class MicroBatchScheduler:
             tenant=tenant,
             tier=tier,
             stream=stream,
+            gang_id=gang,
+            gang_phase=gang_phase,
         )
         # admission discount: only probed when a token budget exists — the
         # probe re-tokenizes the prompt (a second pass on top of
@@ -291,7 +309,13 @@ class MicroBatchScheduler:
                 req.trace_track = t.next_track()
         # the admit is counted by the queue's on_admit hook, under the queue
         # lock, so metrics can never show a completion before its submit
-        return self.queue.submit(req, force=internal)  # raises RequestShed
+        fut = self.queue.submit(req, force=internal)  # raises RequestShed
+        if gang:
+            # AFTER admission: the queue's on_admit hook just assigned the
+            # ledger id (journal.accept), so the membership note carries it;
+            # a shed prompt never joins its gang
+            self.gangs.note_member(gang, req.journal_rid, gang_phase)
+        return fut
 
     def check_admission(self, est_tokens: int = 0, tenant: str = "") -> None:
         """Request-level admission gate for entry points that fan out via
@@ -306,6 +330,32 @@ class MicroBatchScheduler:
                 self.metrics.observe_quota_shed(tenant or "default")
             self._fr("shed", reason=e.reason.value, tenant=tenant)
             raise
+
+    def admit_gang(self, gang_id: str, est_tokens: int = 0,
+                   tenant: str = ""):
+        """Gang admission (serve/gang.py): ONE pass through the
+        request-level admission gate admits the whole fan-out — the tenant
+        is billed ``est_tokens`` once, and every internal submit riding the
+        returned handle's gang id is admission-exempt. Raises the typed
+        RequestShed on rejection (counted like any other shed); on success
+        the caller owns the handle and must finish() it when the request
+        terminally resolves."""
+        self.check_admission(est_tokens, tenant)  # raises RequestShed
+        return self.gangs.open(gang_id, tenant=tenant)
+
+    def _on_take(self, batch: list[ServeRequest]) -> None:
+        """Queue on_take hook (runs under the queue lock at the take commit
+        point): count takes where the affinity pick landed >= 2 siblings of
+        one gang in the same batch/slot generation."""
+        if len(batch) < 2:
+            return
+        seen: dict[str, int] = {}
+        for r in batch:
+            if r.gang_id:
+                n = seen.get(r.gang_id, 0) + 1
+                if n == 2:
+                    self.metrics.observe_gang_affinity_pick()
+                seen[r.gang_id] = n
 
     def _fr(self, kind: str, rid: str = "", **fields) -> None:
         """Flight-recorder append, free when no recorder is armed."""
@@ -466,13 +516,15 @@ class MicroBatchScheduler:
         trace_owned: bool = False,
         tenant: str = "",
         tier: str = "interactive",
+        gang: str = "",
+        gang_phase: str = "",
     ) -> list[_Completion]:
         futs = self.submit_many(
             prompts, references=references, cache_hints=cache_hints,
             max_new_tokens=max_new_tokens,
             config=config, deadline=deadline, internal=internal,
             trace=trace, trace_id=trace_id, trace_owned=trace_owned,
-            tenant=tenant, tier=tier,
+            tenant=tenant, tier=tier, gang=gang, gang_phase=gang_phase,
         )
         # lint-allow[unbounded-blocking-wait]: externally bounded — these are request futures EVERY scheduler path resolves (success, typed failure, shed; drain-overrun sheds cover even a wedged engine, and the watchdog resolves hung dispatches typed)
         return [f.result() for f in futs]
@@ -484,6 +536,7 @@ class MicroBatchScheduler:
         trace_id: str | None = None,
         tenant: str = "",
         tier: str = "interactive",
+        gang: str = "",
     ) -> "QueuedBackend":
         """A Backend-protocol view whose generate() routes through this
         scheduler — hand it to a strategy to make its rounds coalesce with
@@ -491,9 +544,13 @@ class MicroBatchScheduler:
         spans on that ONE request timeline (per-prompt sub-tracks).
         ``tenant``/``tier`` stamp every fanned-out prompt with the
         request's QoS class, so a batch-tier summarize's map round stays
-        preemptible and WFQ-scheduled."""
+        preemptible and WFQ-scheduled. ``gang`` (serve/gang.py) stamps
+        every fanned-out prompt with the request's structured-job id AND
+        unlocks the view's streaming submit_round/harvest protocol for
+        strategies that overlap their reduce with the map fan-out."""
         return QueuedBackend(self, deadline=deadline, trace=trace,
-                             trace_id=trace_id, tenant=tenant, tier=tier)
+                             trace_id=trace_id, tenant=tenant, tier=tier,
+                             gang=gang)
 
     # -- scheduler thread ------------------------------------------------
 
@@ -1248,6 +1305,13 @@ class QueuedBackend:
     strategy run is aborted with the typed shed, matching the all-or-nothing
     semantics a deadline implies. ``records`` accumulates the per-request
     observability of every completed prompt for response-inline reporting.
+
+    Streaming protocol (serve/gang.py): ``submit_round``/``harvest`` are
+    the non-blocking half of generate() — a strategy that detects them
+    submits a fan-out round and harvests completions as they land, so its
+    reduce phase starts building while slow map children still decode
+    instead of barriering on the whole round. Plain offline backends don't
+    expose the pair, so strategies fall back to the barrier path there.
     """
 
     name = "queued"
@@ -1256,7 +1320,8 @@ class QueuedBackend:
                  deadline: float | None = None,
                  trace: RequestTrace | None = None,
                  trace_id: str | None = None,
-                 tenant: str = "", tier: str = "interactive") -> None:
+                 tenant: str = "", tier: str = "interactive",
+                 gang: str = "") -> None:
         self.scheduler = scheduler
         self.deadline = deadline
         # ONE RequestTrace for the whole strategy run: every round's prompts
@@ -1267,6 +1332,9 @@ class QueuedBackend:
         # QoS class every fanned-out prompt inherits (serve/qos.py)
         self.tenant = tenant
         self.tier = tier
+        # structured-job id every fanned-out prompt inherits (serve/gang.py);
+        # "" = ungrouped (the raw /v1/generate path)
+        self.gang_id = gang
         # streaming-summarize progress hook (serve/server.py): called with
         # the completed-prompt count after each round's completions land —
         # the SSE "progress" event source. None = no streaming
@@ -1297,13 +1365,84 @@ class QueuedBackend:
             cache_hints=cache_hints,
             trace=self.trace, trace_id=self.trace_id, trace_owned=True,
             tenant=self.tenant, tier=self.tier,
+            # phase unlabeled: a barrier-mode generate() has no phase
+            # knowledge (strategies that do label use submit_round)
+            gang=self.gang_id,
         )
+        if self.gang_id:
+            self.scheduler.gangs.flush(self.gang_id)
         with self._lock:
             self.records.extend(c.record for c in completions)
             done = len(self.records)
         if self.progress is not None:
             self.progress(done)
         return [c.text for c in completions]
+
+    # -- streaming fan-out (serve/gang.py) --------------------------------
+
+    def submit_round(
+        self,
+        prompts: list[str],
+        *,
+        phase: str = "map",
+        max_new_tokens: int | None = None,
+        config: GenerationConfig | None = None,
+        references: list[str | None] | None = None,
+        cache_hints: list[str | None] | None = None,
+    ) -> list:
+        """Submit one fan-out round WITHOUT blocking: returns the futures
+        aligned with ``prompts`` for ``harvest`` to drain in completion
+        order. ``phase`` labels the members in the gang's journal record
+        ("map" / "reduce" / "outline" / "expand") — the per-phase progress
+        the poll surface reports. The gang's membership is flushed as one
+        typed GANG record right after the round's admissions."""
+        if not prompts:
+            return []
+        futs = self.scheduler.submit_many(
+            prompts, references=references, cache_hints=cache_hints,
+            max_new_tokens=max_new_tokens, config=config,
+            deadline=self.deadline, internal=True,
+            trace=self.trace, trace_id=self.trace_id, trace_owned=True,
+            tenant=self.tenant, tier=self.tier,
+            gang=self.gang_id, gang_phase=phase if self.gang_id else "",
+        )
+        if self.gang_id:
+            self.scheduler.gangs.flush(self.gang_id)
+        return futs
+
+    def harvest(self, fut, *, tolerate_poison: bool = False) -> str | None:
+        """Resolve ONE submit_round future: the text on success (progress
+        fires per completion — the streaming client's per-child progress
+        events), or None when ``tolerate_poison`` and the member failed
+        typed POISON — the gang is marked ``partial`` (journaled) and the
+        caller's reduce proceeds over the survivors. Every other failure
+        (transient-out-of-budget, fatal, shed, cancelled) re-raises: a
+        degraded summary is a poison-only contract, infrastructure
+        failures still fail the request."""
+        from .supervisor import FailureClass, RequestFailed
+
+        try:
+            # lint-allow[unbounded-blocking-wait]: externally bounded — same contract as generate_sync: every scheduler path resolves request futures (success, typed failure, shed, watchdog-resolved hangs)
+            c = fut.result()
+        except RequestFailed as e:
+            if (
+                tolerate_poison
+                and self.gang_id
+                and e.failure_class is FailureClass.POISON
+            ):
+                self.scheduler.gangs.mark_partial(self.gang_id)
+                with self._lock:
+                    done = len(self.records)
+                if self.progress is not None:
+                    self.progress(done)
+                return None
+            raise
+        with self._lock:
+            self.records.append(c.record)
+            done = len(self.records)
+        if self.progress is not None:
+            self.progress(done)
+        return c.text
 
     def count_tokens(self, text: str) -> int:
         return self.scheduler.backend.count_tokens(text)

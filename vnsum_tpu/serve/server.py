@@ -392,6 +392,14 @@ class ServeState:
             return 0
         t0 = time.monotonic()
         n = 0
+        # rebuild live gang groups FIRST: replayed members must rejoin
+        # their structured job (membership and partiality come from the
+        # journal's typed GANG records, not from re-deriving trace prefixes)
+        restored = self.scheduler.gangs.restore(
+            self.journal.gangs_unfinished()
+        )
+        if restored:
+            logger.info("journal replay: restored %d live gang(s)", restored)
         for entry in self.journal.take_unfinished():
             p = entry.payload
             deadline_unix = p.get("deadline_unix")
@@ -429,6 +437,8 @@ class ServeState:
                     # billing its tenant
                     tenant=p.get("tenant", ""),
                     tier=p.get("tier", "interactive"),
+                    gang=p.get("gang", ""),
+                    gang_phase=p.get("gang_phase", ""),
                 )
             # lint-allow[swallowed-exception]: a shutdown shed at replay is already journaled typed-FAILED by the queue's on_shed hook — the ledger entry is resolved
             except RequestShed:
@@ -827,6 +837,7 @@ def make_handler(state: ServeState):
                             state.tenants.stats()
                             if state.tenants is not None else None
                         ),
+                        gang_state=state.scheduler.gangs.stats(),
                         slo_state=(
                             state.slo.export_state()
                             if state.slo is not None else None
@@ -916,16 +927,50 @@ def make_handler(state: ServeState):
                     {"error": f"unknown or expired request id {rid!r}"}, 404
                 )
                 return
-            # retry/fan-out aggregation (incl. the cancelled state) is the
-            # ONE shared fold in serve/journal.py — the DELETE surface uses
-            # the same one, so the two can never disagree
-            from .journal import aggregate_status
+            # retry/fan-out aggregation (incl. the cancelled and partial
+            # states) is the ONE shared fold in serve/journal.py — the
+            # DELETE surface uses the same one, so the two can never
+            # disagree
+            from .journal import EV_COMPLETE, EV_STREAM, aggregate_status
 
-            self._json({
+            payload = {
                 "request_id": rid,
                 "status": aggregate_status(entries),
                 "entries": [e.to_dict() for e in entries],
-            })
+            }
+            # structured jobs: the typed GANG records turn the flat entry
+            # list into PER-PHASE progress (map 12/40 done, reduce started)
+            # — a polling client of a long fan-out sees where it is, not
+            # just a state fold
+            ginfo = (state.journal.gang_info(rid)
+                     or state.scheduler.gangs.lookup(rid))
+            if ginfo and ginfo.get("members"):
+                by_rid = {e.rid: e for e in entries}
+                phases: dict[str, dict] = {}
+                for mrid, phase in ginfo["members"].items():
+                    ph = phases.setdefault(
+                        phase or "unphased",
+                        {"total": 0, "done": 0, "failed": 0, "running": 0,
+                         "streaming": 0},
+                    )
+                    ph["total"] += 1
+                    e = by_rid.get(mrid)
+                    if e is None:
+                        ph["running"] += 1
+                    elif e.status == EV_COMPLETE:
+                        ph["done"] += 1
+                    elif e.terminal:
+                        ph["failed"] += 1
+                    else:
+                        ph["running"] += 1
+                        if e.status == EV_STREAM:
+                            ph["streaming"] += 1
+                payload["gang"] = {
+                    "members": len(ginfo["members"]),
+                    "partial": bool(ginfo.get("partial")),
+                    "phases": phases,
+                }
+            self._json(payload)
 
         # request bodies beyond this are refused outright: a huge (or
         # negative, which would read to EOF and wedge the handler thread)
@@ -1460,13 +1505,13 @@ def make_handler(state: ServeState):
             )
             qbackend = state.scheduler.backend_view(
                 deadline=deadline, trace=trace, trace_id=self._rid,
-                tenant=tenant, tier=tier,
+                tenant=tenant, tier=tier, gang=self._rid,
             )
             t0 = time.monotonic()
 
             def payload_from(result) -> dict:
                 recs = qbackend.records
-                return {
+                payload = {
                     "approach": approach,
                     "summary": clean_thinking_tokens(result.summary),
                     "num_chunks": result.num_chunks,
@@ -1481,6 +1526,16 @@ def make_handler(state: ServeState):
                         "total_s": round(time.monotonic() - t0, 6),
                     },
                 }
+                # degraded fan-out (a POISON member was dropped from the
+                # reduce): say so on the reply, not just in the journal
+                ginfo = (
+                    state.scheduler.gangs.lookup(self._rid)
+                    or (state.journal.gang_info(self._rid)
+                        if state.journal is not None else None)
+                )
+                if ginfo and ginfo.get("partial"):
+                    payload["partial"] = True
+                return payload
 
             try:
                 # request-level admission: the strategy's rounds fan out as
@@ -1496,17 +1551,25 @@ def make_handler(state: ServeState):
                     or state.tenants is not None
                     else 0
                 )
-                state.scheduler.check_admission(est_tokens, tenant)
+                # gang admission: ONE pass through the gate admits the
+                # whole fan-out (billed once) and opens the structured-job
+                # group every internal submit below joins
+                gang = state.scheduler.admit_gang(
+                    self._rid, est_tokens, tenant=tenant
+                )
             except RequestShed as e:
                 if state.obs is not None:
                     state.obs.finish_request(trace, f"shed:{e.reason.value}")
                 self._shed_response(e)
                 return
             if self._stream_requested(req):
-                self._summarize_stream(
-                    text, approach, max_new_tokens, qbackend, trace,
-                    payload_from,
-                )
+                try:
+                    self._summarize_stream(
+                        text, approach, max_new_tokens, qbackend, trace,
+                        payload_from,
+                    )
+                finally:
+                    gang.finish()
                 return
             try:
                 strategy = state.strategy_for(approach, max_new_tokens)
@@ -1536,9 +1599,18 @@ def make_handler(state: ServeState):
                 logger.exception("summarize failed")
                 self._json({"error": str(e)}, 500)
                 return
+            else:
+                # build the reply while the live group still exists — the
+                # partial flag must survive even with journaling off
+                reply = payload_from(result)
+            finally:
+                # the structured job terminally resolved either way: flush
+                # any unflushed membership and drop the live group (the
+                # journal keeps the durable record)
+                gang.finish()
             if state.obs is not None:
                 state.obs.finish_request(trace, "ok")
-            self._json(payload_from(result))
+            self._json(reply)
 
         def _summarize_stream(self, text, approach, max_new_tokens,
                               qbackend, trace, payload_from) -> None:
@@ -1729,7 +1801,15 @@ def main(argv: list[str] | None = None) -> int:
                         "work")
     p.add_argument("--preempt-budget", type=int, default=16,
                    help="max lifetime preemptions per batch-tier request "
-                        "before it becomes non-evictable (starvation bound)")
+                        "before it becomes non-evictable (starvation bound; "
+                        "billed per GANG for structured jobs — any member "
+                        "at budget makes the whole group non-evictable)")
+    p.add_argument("--no-gang-affinity", action="store_true",
+                   help="disable the queue's gang-affinity pick (siblings "
+                        "of one structured job no longer cluster into the "
+                        "same slot generation; admission, membership "
+                        "journaling, and whole-gang QoS stay on — this is "
+                        "the bench A/B lever, not a gang kill-switch)")
     p.add_argument("--stream-heartbeat-s", type=float, default=15.0,
                    help="SSE keepalive: emit ': heartbeat' comment frames "
                         "after this much quiet so idle proxies keep the "
@@ -1941,6 +2021,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     if args.inflight:
         state.scheduler.preempt_budget = max(args.preempt_budget, 1)
+    if args.no_gang_affinity:
+        state.scheduler.queue.gang_affinity = False
     # crash recovery BEFORE accepting new traffic: unfinished journaled
     # requests re-enqueue (the scheduler thread is already live, so replay
     # dispatch overlaps server bring-up)

@@ -3,6 +3,7 @@ from .critique import MapReduceCritiqueStrategy
 from .hierarchical import HierarchicalStrategy
 from .iterative import IterativeStrategy
 from .mapreduce import MapReduceStrategy
+from .skeleton import SkeletonStrategy
 from .truncated import TruncatedStrategy
 
 __all__ = [
@@ -15,4 +16,5 @@ __all__ = [
     "IterativeStrategy",
     "TruncatedStrategy",
     "HierarchicalStrategy",
+    "SkeletonStrategy",
 ]

@@ -188,6 +188,31 @@ HIERARCHICAL_REDUCE = (
     "Tóm tắt mới:"
 )
 
+# Skeleton-of-Thought (arXiv 2307.15337) — no reference-runner counterpart:
+# the outline/expand pair is new here, written in the same register as the
+# reference prompts (full-sentence Vietnamese, no bullets in the output, no
+# meta-talk). The outline asks for a NUMBERED skeleton because the strategy
+# parses "1. ..." lines to build the expansion fan-out.
+SKELETON_OUTLINE = """Bạn là một chuyên gia phân tích văn bản.
+Hãy đọc tài liệu sau và lập một dàn ý gồm 3 đến 8 ý chính bao quát nội dung, mỗi ý trên một dòng theo định dạng "1. ...", "2. ...".
+Mỗi ý chỉ viết ngắn gọn trong một câu. Chỉ viết dàn ý, không giải thích, không mở đầu.
+
+Tài liệu:
+{content}
+
+Dàn ý:"""
+
+SKELETON_EXPAND = """Bạn là một chuyên gia tóm tắt nội dung. Dựa trên tài liệu dưới đây, hãy viết một đoạn văn ngắn bằng **tiếng Việt** triển khai ý sau của bản tóm tắt.
+Chỉ viết nội dung của đoạn văn, bằng câu đầy đủ, không sử dụng dấu đầu dòng, không giải thích, không nói về quy trình.
+
+Ý cần triển khai:
+{point}
+
+Tài liệu:
+{content}
+
+Đoạn văn:"""
+
 # final grammar/flow polish — runners/..._hierarchical.py:296-313
 HIERARCHICAL_POLISH = (
     "Bạn là một biên tập viên chuyên nghiệp.\n"
