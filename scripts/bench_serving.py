@@ -712,6 +712,94 @@ def fleet_phase(args) -> dict:
     }
 
 
+def federation_phase(args) -> dict:
+    """Fleet observability tax (serve/federation.py, ISSUE 19): identical
+    saturating load through a 2-worker router with the federation scrape
+    loop ON (fast cadence, so the bench is an upper bound on the shipped
+    1 s default) vs OFF (``--no-federation``). The scrape loop runs on its
+    own thread against each worker's JSON snapshot endpoint — the A/B
+    charges exactly that: snapshot serialization on the workers plus
+    scrape folding on the router, under load. Acceptance:
+    ``--federation-max-overhead-pct`` (default 1%) of fleet goodput."""
+    from vnsum_tpu.serve.router import (
+        RouterState,
+        Worker as FleetWorker,
+        make_router_server,
+    )
+
+    deadline_s = args.deadline_s * 2
+    short = "tin ngan gon sau day chi tam tu"
+    long_ = "phan tich chuyen sau ve tinh hinh kinh te xa hoi " * 6
+
+    def payload(cid, i):
+        return {
+            "prompt": short if (cid + i) % 2 else long_,
+            "deadline_ms": deadline_s * 1000,
+        }
+
+    def run_arm(federate: bool):
+        workers, parts = [], []
+        for k in range(2):
+            backend = FakeBackend(
+                batch_overhead_s=args.fleet_batch_overhead_s,
+                per_prompt_s=args.per_prompt_s,
+            )
+            state = ServeState(
+                backend,
+                max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1000.0,
+                max_queue_depth=128,
+                trace_sample=0.0,
+            )
+            server = make_server(state, "127.0.0.1", 0)
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            workers.append(FleetWorker(f"w{k}", "127.0.0.1",
+                                       server.server_address[1]))
+            parts.append((state, server))
+        rstate = RouterState(
+            workers, probe_interval_s=0.05, probe_timeout_s=2.0,
+            down_after=2, up_after=1,
+            federate=federate,
+            # 5x the shipped default cadence: the measured tax bounds it
+            federation_interval_s=0.2,
+        )
+        rstate.start()
+        rserver = make_router_server(rstate, "127.0.0.1", 0)
+        threading.Thread(target=rserver.serve_forever, daemon=True).start()
+        rstate.wait_ready(timeout_s=10.0)
+        base = f"http://127.0.0.1:{rserver.server_address[1]}"
+        clients = max(args.clients, 4 * args.max_batch)
+        loop = closed_loop(base, clients, args.per_client, deadline_s,
+                           payload)
+        stats = (rstate.federation.stats_dict()
+                 if rstate.federation is not None else None)
+        rserver.shutdown()
+        rserver.server_close()
+        rstate.close(drain_timeout_s=2.0)
+        for state, server in parts:
+            server.shutdown()
+            server.server_close()
+            state.close()
+        return {**loop, "federation_stats": stats}
+
+    off = run_arm(False)
+    on = run_arm(True)
+    overhead_pct = (
+        round(max(0.0, (off["goodput_rps"] - on["goodput_rps"])
+                  / off["goodput_rps"] * 100.0), 2)
+        if off["goodput_rps"] else 0.0
+    )
+    return {
+        "workload": "2-worker fleet, saturating mixed short/long closed "
+                    "loop; federation scrape loop at 200 ms cadence (5x "
+                    "the shipped 1 s default) vs --no-federation",
+        "federation_off": off,
+        "federation_on": on,
+        "federation_overhead_pct": overhead_pct,
+    }
+
+
 def qos_phase(args) -> dict:
     """Multi-tenant QoS under saturation (ISSUE 12 tentpole): the
     interactive tenant's ANCHORED TTFT p99 with a batch tenant saturating
@@ -1660,6 +1748,10 @@ def main(argv=None) -> int:
                         "of the single-process rate (cache-affinity "
                         "routing must keep hint reuse sticky)")
     # QoS phase knobs (multi-tenant weighted-fair scheduling + preemption)
+    p.add_argument("--federation-max-overhead-pct", type=float, default=1.0,
+                   help="max %% fleet goodput the federation scrape loop "
+                        "may cost vs --no-federation on identical load "
+                        "(measured at 5x the shipped cadence)")
     p.add_argument("--qos-slots", type=int, default=4)
     p.add_argument("--qos-interactive-clients", type=int, default=4)
     p.add_argument("--qos-batch-clients", type=int, default=12)
@@ -1716,7 +1808,7 @@ def main(argv=None) -> int:
                         "affinity-off arm (near-parity is expected on the "
                         "homogeneous workload; this is a no-regression "
                         "guard, not a win claim)")
-    p.add_argument("--out", default="BENCH_serving_r12.json")
+    p.add_argument("--out", default="BENCH_serving_r13.json")
     p.add_argument("--min-speedup", type=float, default=4.0,
                    help="exit non-zero below this goodput ratio (CI smoke "
                         "passes a softer floor: shared 2-core runners get "
@@ -1846,6 +1938,10 @@ def main(argv=None) -> int:
     print("fleet phase ...", flush=True)
     fleet = fleet_phase(args)
 
+    # 8c) fleet observability: federation scrape loop on/off goodput A/B
+    print("federation phase ...", flush=True)
+    federation = federation_phase(args)
+
     # 9) multi-tenant QoS: interactive TTFT p99 under batch saturation
     print("qos phase ...", flush=True)
     qos = qos_phase(args)
@@ -1906,6 +2002,7 @@ def main(argv=None) -> int:
         "journal": journal,
         "sharded": sharded,
         "fleet": fleet,
+        "federation": federation,
         "qos": qos,
         "cancel": cancel,
         "slo": slo,
@@ -1969,6 +2066,16 @@ def main(argv=None) -> int:
         f"{fleet['affinity']['fleet2']['worker_requests']})"
     )
     print(
+        f"federation: scrape-loop overhead "
+        f"{federation['federation_overhead_pct']}% "
+        f"({federation['federation_on']['goodput_rps']} vs "
+        f"{federation['federation_off']['goodput_rps']} rps; "
+        f"{federation['federation_on']['federation_stats']['scrapes']} "
+        f"scrapes, "
+        f"{federation['federation_on']['federation_stats']['errors']} "
+        f"errors)"
+    )
+    print(
         f"qos: interactive TTFT p99 {qos['unloaded']['ttft_p99_s']}s "
         f"unloaded -> {qos['loaded']['ttft_p99_s']}s under batch "
         f"saturation ({qos['interactive_ttft_p99_degradation_pct']}% "
@@ -2023,6 +2130,13 @@ def main(argv=None) -> int:
         # cache-affinity routing must keep shared-prefix reuse sticky
         and fleet["goodput_scaling"] >= args.fleet_min_scaling
         and fleet["affinity"]["hit_rate_ratio"] >= args.fleet_min_affinity
+        # fleet observability: the federation scrape loop must be ~free
+        # against fleet goodput, and the armed arm must actually have
+        # scraped cleanly (a loop that never ran proved nothing)
+        and federation["federation_overhead_pct"]
+        <= args.federation_max_overhead_pct
+        and federation["federation_on"]["federation_stats"]["scrapes"] > 0
+        and federation["federation_on"]["federation_stats"]["errors"] == 0
         # multi-tenant QoS: the interactive tail must hold under batch
         # saturation, and the preemption path must actually have fired
         # (a run that never preempted proved nothing)

@@ -37,8 +37,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import shutil
+import signal
 import sys
 import tempfile
 import threading
@@ -953,6 +955,21 @@ def fleet_soak(args) -> int:
                 return 1
             polled += 1
 
+        # operator incident: SIGUSR1 to the quiesced router fans out
+        # POST /debug/dump to every (respawned) worker — the deterministic
+        # bundle the offline validator audits below, on top of whatever
+        # failover/markdown incidents the kills themselves minted
+        if hasattr(signal, "SIGUSR1"):
+            os.kill(router.proc.pid, signal.SIGUSR1)
+            t_inc = time.monotonic() + 15.0
+            incidents_root = Path(fleet_dir) / "incidents"
+            while time.monotonic() < t_inc:
+                manifests = list(incidents_root.glob("inc_*/manifest.json"))
+                if any(json.loads(m.read_text()).get("reason") == "operator"
+                       for m in manifests):
+                    break
+                time.sleep(0.2)
+
         # graceful exit: SIGTERM drains the front door, drains every
         # worker (exit 0 each), seals the router journal, exits 0
         router.sigterm()
@@ -991,6 +1008,53 @@ def fleet_soak(args) -> int:
         ):
             client_vs_ledger.append(rid)
 
+    # -- offline audit of the INCIDENT bundles (read-only) -----------------
+    # the correlated-capture invariant: at least one bundle is well-formed
+    # (manifest + router ring + >= 2 worker contributions under ONE
+    # incident id) and folds into a monotone timeline — the exact artifact
+    # an operator would open first after this soak's kills
+    from vnsum_tpu.serve.federation import fold_incident_bundle
+    from incident_report import render_text
+
+    incident_best: dict | None = None
+    incident_bundles = 0
+    for manifest_path in sorted(
+        (Path(fleet_dir) / "incidents").glob("inc_*/manifest.json")
+    ):
+        incident_bundles += 1
+        bundle = manifest_path.parent
+        try:
+            report = fold_incident_bundle(bundle)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"incident bundle {bundle.name}: unreadable ({e})")
+            continue
+        walls = [e["wall"] for e in report["events"]]
+        worker_sources = [s for s in report["sources"] if s != "router"]
+        well_formed = (
+            report["incident"] == bundle.name
+            and report["reason"] in ("slo_fast_burn", "markdown",
+                                     "failover", "operator")
+            and "router" in report["sources"]
+            and len(worker_sources) >= 2
+            and report["sources"]["router"]["events"] > 0
+            and walls == sorted(walls)
+            and bool(walls)
+        )
+        if well_formed and (
+            incident_best is None
+            or len(report["events"]) > incident_best["events"]
+        ):
+            incident_best = {
+                "id": report["incident"],
+                "reason": report["reason"],
+                "sources": {s: i["events"]
+                            for s, i in report["sources"].items()},
+                "events": len(report["events"]),
+                "timeline_monotone": True,
+            }
+            # the report CLI consumes the same fold — smoke its rendering
+            render_text(report, limit=5)
+
     workers_tbl = health.get("workers", [])
     failovers = sum(w.get("failovers", 0) for w in workers_tbl)
     restarts = sum(w.get("restarts", 0) for w in workers_tbl)
@@ -1015,6 +1079,9 @@ def fleet_soak(args) -> int:
         "client_saw_200": len(driver.completed),
         "polled_after_kills": polled,
         "router_sheds": health.get("sheds", {}),
+        "incident_bundles": incident_bundles,
+        "incident_validated": incident_best,
+        "router_incident_counts": health.get("incidents", {}),
     }
     print(json.dumps(record, indent=2, ensure_ascii=False))
     if args.out:
@@ -1034,10 +1101,16 @@ def fleet_soak(args) -> int:
         # retried inline) onto a survivor
         and bool(kills)
         and failovers + restarts > 0
+        # correlated incident capture: at least one well-formed bundle —
+        # router ring + >= 2 worker contributions under one incident id,
+        # folded into a monotone timeline
+        and incident_best is not None
     )
     print("fleet ledger invariant:", "OK" if ok else "VIOLATED")
     print(f"kills={len(kills)} rolling_waves={rolling_waves} "
-          f"failovers={failovers} restarts={restarts}")
+          f"failovers={failovers} restarts={restarts} "
+          f"incident_bundles={incident_bundles} "
+          f"incident_validated={incident_best['id'] if incident_best else None}")
     return 0 if ok else 1
 
 

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Fold a fleet incident bundle into one causally-ordered timeline.
+
+An incident bundle (serve/federation.py IncidentManager) is a directory of
+per-process evidence: ``manifest.json``, ``router.json`` (the router's
+routing-decision flight-recorder ring), and ``worker_<name>.json`` files
+(each worker's ring + thread stacks). Every ring event carries ``t_rel``
+seconds since ITS process started plus that ring's ``started_wall``
+anchor — so each event maps onto wall time using only its own process's
+anchors, and the merged timeline is monotone by construction.
+
+Usage::
+
+    python scripts/incident_report.py <bundle_dir>            # human text
+    python scripts/incident_report.py <bundle_dir> --json     # machine
+    python scripts/incident_report.py <bundle_dir> --limit 50
+
+The heavy lifting (loading + folding) lives in
+``vnsum_tpu.serve.federation.fold_incident_bundle`` so the chaos soak's
+bundle validator and the tests consume the same code path.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from vnsum_tpu.serve.federation import fold_incident_bundle  # noqa: E402
+
+
+def render_text(report: dict, limit: int | None = None) -> str:
+    """The human rendering: header, per-source counts, then one line per
+    event — absolute wall stamp, +offset from the first event, source,
+    kind, and whatever typed fields the event carried."""
+    lines = [
+        f"incident  : {report['incident']}",
+        f"reason    : {report['reason']}"
+        + (f" ({report['detail']})" if report.get("detail") else ""),
+        f"captured  : {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime(report['wall']))}"
+        if report.get("wall") else "captured  : ?",
+        "sources   : " + ", ".join(
+            f"{name}={info.get('events', 0)}ev"
+            for name, info in sorted(report["sources"].items())
+        ),
+        "",
+    ]
+    events = report["events"]
+    shown = events if limit is None else events[-limit:]
+    if shown is not events:
+        lines.append(f"... {len(events) - len(shown)} earlier event(s) "
+                     "elided (--limit)")
+    t0 = shown[0]["wall"] if shown else 0.0
+    for e in shown:
+        extras = " ".join(
+            f"{k}={v}" for k, v in e.items()
+            if k not in ("wall", "source", "kind", "seq")
+        )
+        lines.append(
+            f"{e['wall']:.6f} +{e['wall'] - t0:8.3f}s "
+            f"[{e['source']:>10}] {e['kind']:<16} {extras}".rstrip()
+        )
+    if not shown:
+        lines.append("(no events in any ring)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="incident_report")
+    p.add_argument("bundle", help="incident bundle directory "
+                                  "(<incident-dir>/<incident-id>)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the folded report as JSON instead of text")
+    p.add_argument("--limit", type=int, default=None,
+                   help="show only the last N events (text mode)")
+    args = p.parse_args(argv)
+
+    bundle = Path(args.bundle)
+    if not (bundle / "manifest.json").exists():
+        print(f"error: {bundle} has no manifest.json — not an incident "
+              "bundle", file=sys.stderr)
+        return 2
+    report = fold_incident_bundle(bundle)
+    try:
+        if args.json:
+            print(json.dumps(report, ensure_ascii=False, indent=2))
+        else:
+            print(render_text(report, limit=args.limit))
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe; not an error
+        sys.stderr.close()
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

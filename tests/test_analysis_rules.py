@@ -470,6 +470,51 @@ def test_label_cardinality_suppression_with_reason_clears(tmp_path):
                             ["metric-label-cardinality"]) == []
 
 
+WORKER_LABEL_SRC = """
+    def render(self, lines, name, registry):
+        lines.append(f'a_total{{worker="{registry.canonical(name)}"}} 1')
+        lines.append(f'b_total{{worker="{canonical(name)}"}} 1')
+        lines.append(f'c_total{{worker="{name}"}} 1')              # raw: flagged
+        for worker in SomeEnum:
+            lines.append(f'd_total{{worker="{worker.value}"}} 1')  # enum: flagged
+        for wname in ("w0", "w1"):
+            lines.append(f'e_total{{worker="{wname}"}} 1')         # loop: flagged
+"""
+
+
+def test_label_cardinality_worker_requires_canonical_call(tmp_path):
+    """Fleet worker= labels are held to the STRICT form: only a
+    canonical(...) call on the roster registry proves the emission agrees
+    with the bounded worker set — the enum and literal-loop escapes that
+    clear other labels do NOT clear worker=."""
+    findings = _serve_lint_rule(tmp_path, WORKER_LABEL_SRC,
+                                ["metric-label-cardinality"])
+    assert len(findings) == 3
+    assert all('worker="..."' in f.message for f in findings)
+    assert all("worker-roster" in f.message for f in findings)
+    flagged_lines = sorted(f.line for f in findings)
+    src_lines = textwrap.dedent(WORKER_LABEL_SRC).splitlines()
+    assert ["c_total", "d_total", "e_total"] == [
+        next(tok for tok in ("a_total", "b_total", "c_total",
+                             "d_total", "e_total")
+             if tok in src_lines[ln - 1])
+        for ln in flagged_lines
+    ]
+
+
+def test_label_cardinality_worker_canonical_forms_clear(tmp_path):
+    src = """
+    def render(self, lines, rows, registry):
+        for r in rows:
+            name = r["name"]
+            lines.append(
+                f'up{{worker="{registry.canonical(name, touch=False)}"}} 1'
+            )
+    """
+    assert _serve_lint_rule(tmp_path, src,
+                            ["metric-label-cardinality"]) == []
+
+
 def _serve_lint_rule(tmp_path, src: str, rules):
     d = tmp_path / "vnsum_tpu" / "serve"
     d.mkdir(parents=True, exist_ok=True)

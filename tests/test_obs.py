@@ -85,6 +85,55 @@ def test_histogram_to_dict_has_quantiles():
     assert set(d) >= {"p50", "p95", "p99", "sum", "count", "buckets"}
 
 
+def test_histogram_merge_mismatched_ladders_raises_typed():
+    from vnsum_tpu.obs.histogram import HistogramMergeError
+
+    a = Histogram((0.1, 1.0))
+    b = Histogram((0.1, 1.0, 10.0))
+    with pytest.raises(HistogramMergeError) as exc:
+        a.merge_from(b)
+    # the typed error IS the fleet-federation contract: a ValueError
+    # subclass a rollup can catch without masking real bugs
+    assert isinstance(exc.value, ValueError)
+    assert "different bounds" in str(exc.value)
+    # from_state hits the same typed error on a counts/ladder mismatch
+    state = a.state_dict()
+    state["counts"] = state["counts"][:-1]
+    with pytest.raises(HistogramMergeError):
+        Histogram.from_state(state)
+
+
+def test_histogram_merge_equals_observing_union():
+    """Property: merging N worker-shaped histograms (state_dict ->
+    from_state -> merge_from, the federation round trip) is EXACTLY
+    observing the union of their samples — counts vector, sum, count, and
+    every derived percentile agree."""
+    import random
+
+    rng = random.Random(19)
+    bounds = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+    union = Histogram(bounds)
+    merged = None
+    for _worker in range(5):
+        h = Histogram(bounds)
+        for _ in range(rng.randrange(0, 40)):
+            v = rng.choice([rng.uniform(0.0, 0.6), rng.expovariate(0.5)])
+            h.observe(v)
+            union.observe(v)
+        wire = Histogram.from_state(h.state_dict())  # the scrape hop
+        if merged is None:
+            merged = wire
+        else:
+            merged.merge_from(wire)
+    assert merged is not None
+    assert merged.counts == union.counts
+    assert merged.count == union.count
+    assert merged.sum == pytest.approx(union.sum)
+    for q in (0.5, 0.9, 0.95, 0.99):
+        assert merged.percentile(q) == pytest.approx(union.percentile(q))
+    assert merged.fraction_le(0.5) == pytest.approx(union.fraction_le(0.5))
+
+
 # -- rolling window -----------------------------------------------------------
 
 

@@ -251,6 +251,59 @@ def test_inline_failover_replays_onto_survivor(tmp_path):
         handles[1].close()
 
 
+def test_failover_preserves_trace_identity_on_survivor(tmp_path):
+    """Regression: the journal-handoff replay after a worker death must
+    carry the ORIGINAL trace id onto the survivor — the client-facing
+    request id, the X-Request-Id response header, and the survivor's own
+    span ring all name the same trace, so the merged fleet trace can join
+    the pre- and post-failover halves."""
+    live, handles = _spawn_inproc_worker("live")
+    dead = Worker("dead", "127.0.0.1", free_port())
+    state = RouterState([dead, live], journal_dir=tmp_path / "router")
+    _mark_up(state)
+    with state._lock:
+        state._replay_started = state._replay_done = True
+    server = make_router_server(state, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        hint = _hint_for([dead, live], "dead")
+        status, body, resp_headers = _post(
+            base + "/v1/generate",
+            {"prompt": "giữ nguyên dấu vết", "cache_hint": hint,
+             "request_id": "trace-keep-1"},
+        )
+        assert status == 200
+        assert body["request_id"] == "trace-keep-1"
+        assert resp_headers.get("X-Request-Id") == "trace-keep-1"
+        # the survivor's span ring traced the replayed hop under the
+        # ORIGINAL id (not a router-minted replacement); the worker's
+        # trace finishes in its handler's finally — after the response
+        # bytes — so poll briefly
+        _srv, live_state, _t = handles
+        deadline = time.monotonic() + 5.0
+        survivor_ids: set = set()
+        while time.monotonic() < deadline:
+            survivor_ids = {t.trace_id
+                            for t in live_state.obs.snapshot()[0]}
+            if "trace-keep-1" in survivor_ids:
+                break
+            time.sleep(0.02)
+        assert "trace-keep-1" in survivor_ids
+        # and the router's own ring joined the same id, so the two halves
+        # stitch into one merged trace
+        router_ids = {t.trace_id for t in state.obs.snapshot()[0]}
+        assert "trace-keep-1" in router_ids
+    finally:
+        server.shutdown()
+        server.server_close()
+        state.close(drain_timeout_s=2.0)
+        handles[0].shutdown()
+        handles[0].server_close()
+        handles[1].close()
+
+
 def test_startup_replay_hands_unfinished_accepts_to_workers(tmp_path):
     """Router-restart recovery: unfinished ACCEPTs in the router's own
     journal re-dispatch once a worker is routable, and the replayed
@@ -355,11 +408,18 @@ def test_router_metrics_surface(fleet):
         if line.startswith("#") or not line.strip():
             continue
         name = line.split("{")[0].split(" ")[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            # histogram sample names derive from a registered base
+            if name not in registered and name.endswith(suffix):
+                name = name[: -len(suffix)]
         assert name in registered, line
     assert 'vnsum_serve_router_requests_total{worker="w0"}' in text
     assert 'vnsum_serve_router_sheds_total{reason="queue_full"}' in text
     assert "vnsum_serve_journal_pending" in text
     assert "vnsum_serve_router_workers_up 2" in text
+    # fleet federation re-exports ride the same surface
+    assert "vnsum_serve_federation_scrapes_total" in text
+    assert 'vnsum_serve_fleet_incidents_total{reason="failover"} 0' in text
 
 
 def test_cancel_routes_to_ledger(fleet):

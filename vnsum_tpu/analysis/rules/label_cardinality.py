@@ -25,6 +25,13 @@ route it through the registry or carry a reasoned
 ``# lint-allow[metric-label-cardinality]`` explaining why the value set is
 bounded (the SLO gauges do exactly this: objective names are parse-time-
 validated config tokens).
+
+``worker=`` labels (the fleet router/federation series) are held to the
+STRICT form: only a ``canonical(...)`` call qualifies. The worker label
+set is the roster registry seeded at router construction; an enum or a
+literal loop cannot prove an emission site agrees with that roster, and a
+respawn/rename drifting off it must collapse into ``other``, not mint a
+series.
 """
 from __future__ import annotations
 
@@ -38,16 +45,22 @@ _SCOPE_RE = re.compile(r"(^|/)vnsum_tpu/serve/")
 _LABEL_OPEN_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="$')
 
 
-def _bounded(sf: SourceFile, fstr: ast.JoinedStr, expr: ast.expr) -> bool:
-    """Is the interpolated label value drawn from a bounded set?"""
-    # the registry helper: <anything>.canonical(...) / canonical(...)
+def _canonical_call(expr: ast.expr) -> bool:
+    """Is ``expr`` a call to the registry helper —
+    ``<anything>.canonical(...)`` / ``canonical(...)``?"""
     if isinstance(expr, ast.Call):
         func = expr.func
         name = func.attr if isinstance(func, ast.Attribute) else (
             func.id if isinstance(func, ast.Name) else None
         )
-        if name == "canonical":
-            return True
+        return name == "canonical"
+    return False
+
+
+def _bounded(sf: SourceFile, fstr: ast.JoinedStr, expr: ast.expr) -> bool:
+    """Is the interpolated label value drawn from a bounded set?"""
+    if _canonical_call(expr):
+        return True
     # enum idiom: `for reason in ShedReason: ... {reason.value}` — the
     # label set is the enum's members
     if isinstance(expr, ast.Attribute) and expr.attr == "value":
@@ -93,7 +106,23 @@ class LabelCardinalityRule(Rule):
                 ):
                     continue
                 m = _LABEL_OPEN_RE.search(part.value)
-                if m is None or _bounded(sf, node, nxt.value):
+                if m is None:
+                    continue
+                if m.group(1) == "worker":
+                    # fleet worker labels: ONLY the roster registry's
+                    # canonical(...) proves agreement with the bounded
+                    # worker set — enum/literal-loop escapes don't
+                    if _canonical_call(nxt.value):
+                        continue
+                    out.append(Finding(
+                        self.name, sf.path, nxt.value.lineno,
+                        'metric label worker="..." must interpolate a '
+                        "canonical(...) call on the bounded worker-roster "
+                        "registry (enum values and literal loops do not "
+                        "qualify for fleet worker labels)",
+                    ))
+                    continue
+                if _bounded(sf, node, nxt.value):
                     continue
                 out.append(Finding(
                     self.name, sf.path, nxt.value.lineno,

@@ -79,6 +79,110 @@ def chrome_trace(requests, batches) -> dict:
     return {"traceEvents": ev, "displayTimeUnit": "ms"}
 
 
+def trace_state_payload(requests) -> list[dict]:
+    """Raw span state of finished request traces, JSON-shaped — the wire
+    format the fleet router's ``/debug/trace`` stitcher pulls from each
+    worker (``GET /debug/obs/snapshot``). Deliberately NOT chrome_trace():
+    that export rebases timestamps to a per-process epoch, which destroys
+    the cross-process alignment the stitcher needs; this payload keeps the
+    worker's monotonic seconds verbatim and lets the router apply its
+    probe-estimated clock offset before any rebase."""
+    out = []
+    for r in requests:
+        out.append({
+            "trace_id": r.trace_id,
+            "parent": getattr(r, "parent", None),
+            "status": r.status,
+            "t_start": r.t_start,
+            "spans": [
+                {"name": sp.name, "t0": sp.t0, "dur": sp.dur,
+                 "track": sp.track,
+                 **({"args": sp.args} if sp.args else {})}
+                for sp in r.spans_snapshot()
+            ],
+        })
+    return out
+
+
+# per-source track spacing in the merged trace: each contributing process
+# gets its own block of Perfetto tracks within a request's process group,
+# so a worker's per-prompt sub-tracks can never collide with the router's
+_SOURCE_TRACK_STRIDE = 1000
+
+
+def merged_chrome_trace(groups) -> dict:
+    """ONE Chrome trace from the span rings of several PROCESSES — the
+    fleet stitcher (router ``/debug/trace``). ``groups`` is a list of
+    ``{"source": label, "clock_offset_s": off, "traces": [...]}``, where
+    ``traces`` is :func:`trace_state_payload` output from that process and
+    ``off`` maps its monotonic clock into the reference (router) clock:
+    ``t_ref = t + off`` (the router estimates it from probe RTT midpoints;
+    its own group carries 0.0).
+
+    Traces sharing a trace_id — the router's root trace and every worker
+    hop of the same request, INCLUDING the pre- and post-failover halves
+    of a handed-off request — merge into one Perfetto process; each source
+    contributes its own track block, named ``<source>:request`` /
+    ``<source>:prompt N``."""
+    # trace_id -> [(source, clock_offset_s, trace_payload), ...] in group
+    # order, so the reference process (the router) lists first
+    by_id: dict[str, list] = {}
+    for g in groups:
+        off = float(g.get("clock_offset_s") or 0.0)
+        for t in g.get("traces") or []:
+            by_id.setdefault(t["trace_id"], []).append(
+                (g.get("source", "?"), off, t)
+            )
+    epoch = None
+    for contribs in by_id.values():
+        for _src, off, t in contribs:
+            for sp in t.get("spans") or []:
+                t_ref = float(sp["t0"]) + off
+                epoch = t_ref if epoch is None else min(epoch, t_ref)
+    if epoch is None:
+        epoch = 0.0
+    ev: list[dict] = []
+    for i, trace_id in enumerate(sorted(
+        by_id,
+        key=lambda tid: min(
+            (float(sp["t0"]) + off
+             for _s, off, t in by_id[tid] for sp in t.get("spans") or []),
+            default=0.0,
+        ),
+    )):
+        pid = REQUEST_PID0 + i
+        ev.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                   "args": {"name": f"request {trace_id}"}})
+        # one track block per contributing (source, hop): the pre- and
+        # post-failover halves of one request come from different worker
+        # sources and land side by side under the shared trace id
+        for j, (source, off, t) in enumerate(by_id[trace_id]):
+            base = j * _SOURCE_TRACK_STRIDE
+            spans = t.get("spans") or []
+            tracks = sorted({int(sp.get("track", 0)) for sp in spans})
+            for tr in tracks:
+                label = ("request" if tr == 0 else f"prompt {tr - 1}")
+                ev.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": base + tr,
+                    "args": {"name": f"{source}:{label}"},
+                })
+            for sp in spans:
+                e = {
+                    "ph": "X", "name": sp["name"], "pid": pid,
+                    "tid": base + int(sp.get("track", 0)),
+                    "ts": round((float(sp["t0"]) + off - epoch) * 1e6, 3),
+                    "dur": round(max(float(sp["dur"]), 0.0) * 1e6, 3),
+                }
+                args = dict(sp.get("args") or {})
+                args["source"] = source
+                if t.get("parent"):
+                    args.setdefault("parent_span", t["parent"])
+                e["args"] = args
+                ev.append(e)
+    return {"traceEvents": ev, "displayTimeUnit": "ms"}
+
+
 def spans_to_chrome(spans, process_name: str = "pipeline") -> dict:
     """Export a flat span list (e.g. `core/profiling.Tracer.timeline()`) as
     one single-process timeline — how offline pipeline runs share the same

@@ -40,6 +40,17 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f.is_integer() else repr(f)
 
 
+class HistogramMergeError(ValueError):
+    """Two histograms with different bucket ladders cannot be merged.
+
+    Typed (not a bare ValueError) because the fleet federation layer
+    (serve/federation.py) merges histograms scraped off REMOTE processes:
+    a worker running a different build can legitimately ship a different
+    ladder, and the scrape loop must catch exactly this condition and
+    skip the series rather than silently corrupting the rollup counts or
+    swallowing unrelated ValueErrors."""
+
+
 class Histogram:
     """Cumulative fixed-bucket histogram (Prometheus semantics).
 
@@ -80,9 +91,14 @@ class Histogram:
     def merge_from(self, other: "Histogram") -> None:
         """Add ``other``'s counts into this histogram (same bounds required)
         — how `obs/window.WindowedHistogram` folds its live sub-windows into
-        one readable histogram."""
+        one readable histogram, and how the fleet federation rolls worker
+        histograms up. Mismatched ladders raise :class:`HistogramMergeError`
+        instead of silently mis-binning counts."""
         if other.bounds != self.bounds:
-            raise ValueError("cannot merge histograms with different bounds")
+            raise HistogramMergeError(
+                "cannot merge histograms with different bounds: "
+                f"{self.bounds} vs {other.bounds}"
+            )
         for i, n in enumerate(other.counts):
             self.counts[i] += n
         self.sum += other.sum
@@ -176,6 +192,37 @@ class Histogram:
             "p95": round(self.percentile(0.95), 6),
             "p99": round(self.percentile(0.99), 6),
         }
+
+    def state_dict(self) -> dict:
+        """Raw mergeable state (bounds + non-cumulative counts) — the wire
+        format the fleet federation scrapes off each worker's JSON snapshot
+        endpoint. Distinct from :meth:`to_dict`, whose bucket keys are
+        render-formatted strings and whose quantiles are derived."""
+        return {
+            "bounds": list(self.bounds),
+            "counts": list(self.counts),
+            "sum": self.sum,
+            "count": self.count,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "Histogram":
+        """Rebuild a histogram from :meth:`state_dict` output (possibly
+        deserialized from another process). Malformed state — a counts
+        vector that does not match the ladder — raises
+        :class:`HistogramMergeError`, the same typed error a downstream
+        merge would hit."""
+        h = cls(state["bounds"])
+        counts = [int(n) for n in state["counts"]]
+        if len(counts) != len(h.counts):
+            raise HistogramMergeError(
+                f"counts length {len(counts)} does not match ladder of "
+                f"{len(h.bounds)} bounds (+Inf tail)"
+            )
+        h.counts = counts
+        h.sum = float(state["sum"])
+        h.count = int(state["count"])
+        return h
 
     def copy(self) -> "Histogram":
         h = Histogram(self.bounds)

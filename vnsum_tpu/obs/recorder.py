@@ -51,6 +51,12 @@ EVENT_KINDS = (
     # watchdog liveness verdicts (serve/watchdog.py): a thread/dispatch
     # declared stalled, and a wedged-dispatch recovery that answered it
     "stall", "watchdog_recover",
+    # fleet-router routing decisions (serve/router.py keeps its own ring —
+    # the routing half of every incident bundle): a dispatch routed to a
+    # worker, mark-down/mark-up transitions, a journal-handoff failover,
+    # a worker process restart, and a minted incident id
+    "route", "markdown", "markup", "failover", "handoff_replay",
+    "worker_restart", "incident",
 )
 
 _dump_ids = itertools.count(1)

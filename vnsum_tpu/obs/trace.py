@@ -117,11 +117,17 @@ class RequestTrace:
     # this does not move during an untraced serving run
     allocations = 0
 
-    __slots__ = ("trace_id", "t_start", "status", "spans", "_lock", "_tracks")
+    __slots__ = ("trace_id", "t_start", "status", "spans", "_lock",
+                 "_tracks", "parent")
 
-    def __init__(self, trace_id: str) -> None:
+    def __init__(self, trace_id: str, parent: str | None = None) -> None:
         RequestTrace.allocations += 1
         self.trace_id = trace_id
+        # cross-process trace context: the span name of the upstream hop
+        # that dispatched this request (the fleet router's proxy span rides
+        # in on an X-Parent-Span header). The merged fleet trace uses it to
+        # nest worker timelines under the router's root span
+        self.parent = parent
         self.t_start = time.monotonic()
         self.status = "open"                    # guarded by: _lock
         self.spans: list[Span] = []             # guarded by: _lock
@@ -281,8 +287,11 @@ class ObsHub:
 
     # -- request side ----------------------------------------------------
 
-    def start_request(self, trace_id: str) -> RequestTrace | None:
-        """A RequestTrace when this request is sampled, else None."""
+    def start_request(self, trace_id: str,
+                      parent: str | None = None) -> RequestTrace | None:
+        """A RequestTrace when this request is sampled, else None.
+        ``parent`` carries cross-process trace context (the router's
+        X-Parent-Span header) onto the trace."""
         if self.sample <= 0.0:
             return None
         with self._lock:
@@ -290,7 +299,7 @@ class ObsHub:
             if self._acc < 1.0:
                 return None
             self._acc -= 1.0
-        return RequestTrace(trace_id)
+        return RequestTrace(trace_id, parent=parent)
 
     def finish_request(self, trace: RequestTrace | None,
                        status: str = "ok") -> None:

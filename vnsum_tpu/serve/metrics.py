@@ -293,6 +293,57 @@ _reg("router_probe_seconds", "gauge",
      "latency of the most recent readiness probe, by worker")
 _reg("router_sheds_total", "counter",
      "requests shed at the router front door, by reason")
+# -- metrics/SLO federation (serve/federation.py): the router scrapes each
+# worker's JSON snapshot on a cadence and re-exports fleet rollups —
+# counters summed, histograms merged via Histogram.merge_from, gauges kept
+# per worker under the bounded worker label
+_reg("federation_scrapes_total", "counter",
+     "worker snapshot scrapes completed by the router's federation loop, "
+     "by worker")
+_reg("federation_scrape_errors_total", "counter",
+     "worker snapshot scrapes that failed (unreachable worker, bad "
+     "payload, mismatched histogram ladder), by worker")
+_reg("federation_scrape_seconds", "histogram",
+     "wall-clock cost of one worker snapshot scrape (HTTP round trip + "
+     "parse + fold)")
+_reg("federation_staleness_seconds", "gauge",
+     "age of the freshest good snapshot held for each worker, by worker "
+     "(grows while a worker is unreachable)")
+_reg("federation_clock_offset_seconds", "gauge",
+     "estimated worker-monotonic minus router-monotonic clock offset "
+     "(probe RTT midpoint method), by worker — the correction the merged "
+     "/debug/trace applies")
+_reg("fleet_requests_total", "counter",
+     "requests admitted across the fleet (workers' requests_total summed "
+     "at the last federation scrape)")
+_reg("fleet_requests_completed_total", "counter",
+     "requests answered across the fleet (summed rollup)")
+_reg("fleet_requests_errored_total", "counter",
+     "requests failed in engines across the fleet (summed rollup)")
+_reg("fleet_generated_tokens_total", "counter",
+     "tokens generated across the fleet (summed rollup)")
+_reg("fleet_e2e_seconds", "histogram",
+     "end-to-end request latency across the fleet (worker histograms "
+     "merged bucket-wise at the last federation scrape)")
+_reg("fleet_ttft_seconds", "histogram",
+     "time to first token across the fleet (merged rollup; anchored "
+     "observations only, same honesty rule as the worker series)")
+_reg("fleet_queue_depth", "gauge",
+     "requests queued on each worker at its last snapshot, by worker")
+_reg("fleet_worker_up", "gauge",
+     "1 while the router's probe loop marks the worker routable, else 0, "
+     "by worker")
+_reg("fleet_degraded_rung", "gauge",
+     "each worker's degradation-ladder rung at its last snapshot, by "
+     "worker")
+_reg("fleet_slo_burn_fast", "gauge",
+     "each worker's worst fast-window SLO burn rate at its last snapshot, "
+     "by worker (the per-worker burn attribution behind fleet /debug/slo)")
+_reg("fleet_slo_breached", "gauge",
+     "1 while the worker's own SLO engine reports a breach, else 0, by "
+     "worker")
+_reg("fleet_incidents_total", "counter",
+     "correlated incident bundles minted by the router, by trigger reason")
 
 
 def metric_names(full: bool = True) -> list[str]:
@@ -602,6 +653,28 @@ class ServeMetrics:
         """{name: {buckets, sum, count, p50, p95, p99}} for bench JSON."""
         with self._lock:
             return {k: h.to_dict() for k, h in self._hists.items()}
+
+    def federation_snapshot(self) -> dict:
+        """The scrape payload for the fleet router's federation loop
+        (``GET /debug/obs/snapshot``): the counters it sums and the raw
+        histogram state it merges, snapshotted in ONE lock hold so a
+        rollup never ships a count that disagrees with its buckets. Raw
+        ``state_dict`` (bounds + counts), not the render format — the
+        router folds with Histogram.merge_from."""
+        with self._lock:
+            s = self._stats
+            return {
+                "counters": {
+                    "requests_total": s.submitted,
+                    "requests_completed_total": s.completed,
+                    "requests_errored_total": s.errors,
+                    "generated_tokens_total": s.generated_tokens,
+                },
+                "hists": {
+                    "e2e_seconds": self._hists["e2e_seconds"].state_dict(),
+                    "ttft_seconds": self._hists["ttft_seconds"].state_dict(),
+                },
+            }
 
     def now(self) -> float:
         """The metrics' own clock — callers taking multiple window views

@@ -71,6 +71,9 @@ from pathlib import Path
 
 from ..analysis.sanitizers import make_lock
 from ..core.logging import get_logger
+from ..obs.recorder import FlightRecorder
+from ..obs.trace import ObsHub
+from .federation import FleetFederation, IncidentManager
 from .journal import RequestJournal, aggregate_status
 from .metrics import _METRICS, _PREFIX
 from .server import (
@@ -80,6 +83,7 @@ from .server import (
     _number,
     _request_id,
 )
+from .usage import TenantLabelRegistry
 from .watchdog import WATCHDOG_EXIT_CODE
 
 logger = get_logger("vnsum.serve.router")
@@ -136,6 +140,7 @@ class Worker:
         self.ok_streak = 0
         self.last_probe_s = 0.0
         self.last_reason = "unprobed"
+        self.last_markdown_reason = ""  # why the LAST mark-down happened
         self.last_restart = 0.0
         self.handed_off = False  # one monitor handoff per down transition
         # -- counters (router-lock scope; /metrics reads them) --
@@ -151,6 +156,7 @@ class Worker:
             "name": self.name, "host": self.host, "port": self.port,
             "up": self.up, "draining": self.draining,
             "reason": self.last_reason, "inflight": self.inflight,
+            "last_markdown_reason": self.last_markdown_reason,
             "requests": self.requests, "failovers": self.failovers,
             "markdowns": self.markdowns, "markups": self.markups,
             "restarts": self.restarts,
@@ -226,6 +232,11 @@ class RouterState:
         restart_crashed: bool = True,
         restart_backoff_s: float = 1.0,
         probe_slo_burn: bool = True,
+        federate: bool = True,
+        federation_interval_s: float = 1.0,
+        incident_dir: str | Path | None = None,
+        incident_min_interval_s: float = 30.0,
+        trace_ring: int = 256,
     ) -> None:
         self.workers = list(workers)
         self.probe_interval_s = float(probe_interval_s)
@@ -248,6 +259,36 @@ class RouterState:
         if journal_dir:
             self.journal = RequestJournal(
                 journal_dir, fsync_interval_s=journal_fsync_s
+            )
+        # bounded worker-label registry: the fleet roster, seeded at
+        # construction — every worker= label the router's /metrics emits
+        # passes through canonical(), so an off-roster name can never mint
+        # a new series (the metric-label-cardinality contract)
+        self.worker_labels = TenantLabelRegistry(
+            cap=max(64, 2 * len(self.workers) + 8),
+            seed=[w.name for w in self.workers],
+        )
+        # the routing-decision ring: route / markdown / markup / failover /
+        # handoff_replay / worker_restart / incident events — the router's
+        # half of every incident bundle
+        self.recorder = FlightRecorder(capacity=4096,
+                                       directory=incident_dir)
+        # router-side spans for every proxied request — the root of the
+        # stitched fleet trace. sample=1.0: the proxy hop is a worker HTTP
+        # round trip; a handful of span appends is noise against it
+        self.obs = ObsHub(sample=1.0, ring=int(trace_ring))
+        self.federation = (
+            FleetFederation(self, interval_s=federation_interval_s)
+            if federate else None
+        )
+        self.incidents = IncidentManager(
+            self, self.federation, incident_dir,
+            min_interval_s=incident_min_interval_s,
+        )
+        if self.federation is not None:
+            self.federation.fast_burn_cb = (
+                lambda detail: self.incidents.trigger("slo_fast_burn",
+                                                      detail)
             )
         # lock-order: this lock is OUTER to the journal's (journal stays
         # innermost fleet-wide, same as under the queue lock in-process);
@@ -275,6 +316,8 @@ class RouterState:
             target=self._probe_loop, name="router-probe", daemon=True
         )
         self._probe_thread.start()
+        if self.federation is not None:
+            self.federation.start()
 
     def close(self, drain_timeout_s: float = 30.0) -> None:
         """Graceful shutdown: stop admitting (typed 503), drain in-flight
@@ -290,6 +333,8 @@ class RouterState:
                 break
             time.sleep(0.02)
         self._stop.set()
+        if self.federation is not None:
+            self.federation.close()
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=10.0)
         for w in self.workers:
@@ -421,21 +466,34 @@ class RouterState:
             if not ok:
                 reason = (body or {}).get("reason", f"http:{status}")
             elif self.probe_slo_burn:
-                hstatus, hbody = self._worker_http(
-                    w, "GET", "/healthz", timeout=self.probe_timeout_s
-                )
-                slo = (hbody or {}).get("slo") if hstatus == 200 else None
-                if isinstance(slo, str) and slo.startswith("BREACH"):
-                    # the worker's own SLO verdict (slo.status_line()):
-                    # a page-level burn browns the worker out of rotation
-                    # before clients feel the tail
-                    ok = False
-                    reason = "slo_burn"
+                fed = (self.federation.fresh_payload(w.name)
+                       if self.federation is not None else None)
+                if fed is not None:
+                    # federation-fed markdown policy: the scrape loop
+                    # already holds this worker's windowed SLO verdict —
+                    # no second HTTP round trip per probe beat
+                    if (fed.get("slo") or {}).get("breached"):
+                        ok = False
+                        reason = "slo_burn"
+                else:
+                    # no fresh federation sample (loop off, or the worker
+                    # just joined): fall back to the /healthz verdict
+                    hstatus, hbody = self._worker_http(
+                        w, "GET", "/healthz", timeout=self.probe_timeout_s
+                    )
+                    slo = ((hbody or {}).get("slo")
+                           if hstatus == 200 else None)
+                    if isinstance(slo, str) and slo.startswith("BREACH"):
+                        # the worker's own SLO verdict (slo.status_line()):
+                        # a page-level burn browns the worker out of
+                        # rotation before clients feel the tail
+                        ok = False
+                        reason = "slo_burn"
         # lint-allow[swallowed-exception]: ok stays False and the hysteresis below IS the resolution — a refused probe is a strike, not an error
         except OSError:
             pass
         dt = time.monotonic() - t0
-        marked_down = False
+        marked_down = marked_up = False
         with self._lock:
             w.last_probe_s = dt
             w.last_reason = reason if not ok else "ready"
@@ -446,6 +504,7 @@ class RouterState:
                     w.up = True
                     w.markups += 1
                     w.handed_off = False
+                    marked_up = True
                     logger.info("worker %s marked UP", w.name)
             else:
                 w.ok_streak = 0
@@ -453,10 +512,15 @@ class RouterState:
                 if w.up and w.fail_streak >= self.down_after:
                     w.up = False
                     w.markdowns += 1
+                    w.last_markdown_reason = reason
                     marked_down = True
                     logger.warning("worker %s marked DOWN (%s)",
                                    w.name, reason)
+        if marked_up:
+            self.recorder.record("markup", worker=w.name)
         if marked_down:
+            self.recorder.record("markdown", worker=w.name, reason=reason)
+            self.incidents.trigger("markdown", detail=f"{w.name}: {reason}")
             self._spawn_handoff(w, reason)
 
     def _note_death(self, w: Worker, rc: int) -> None:
@@ -470,6 +534,7 @@ class RouterState:
             w.last_reason = reason
             if was_up:
                 w.markdowns += 1
+                w.last_markdown_reason = reason
             need_handoff = not w.handed_off
             w.handed_off = True
             if (
@@ -485,12 +550,16 @@ class RouterState:
         if was_up:
             logger.warning("worker %s died (%s) — marked DOWN",
                            w.name, reason)
+            self.recorder.record("markdown", worker=w.name, reason=reason)
+            self.incidents.trigger("markdown", detail=f"{w.name}: {reason}")
         if need_handoff:
             self._spawn_handoff(w, reason)
         if respawn:
             # the respawned worker replays ITS journal before /readyz says
             # 200 (pre_replay), so it re-enters rotation fully recovered
             logger.info("respawning worker %s after %s", w.name, reason)
+            self.recorder.record("worker_restart", worker=w.name,
+                                 reason=reason)
             w.handle.start()
 
     # -- journal-handoff failover ------------------------------------------
@@ -513,6 +582,12 @@ class RouterState:
                 if wn == worker.name and rid not in self._claimed
             ]
             self._claimed.update(rids)
+        if rids:
+            self.recorder.record("failover", worker=worker.name,
+                                 reason=reason, rids=len(rids))
+            self.incidents.trigger("failover",
+                                   detail=f"{worker.name}: {reason} "
+                                          f"({len(rids)} rid(s))")
         n = 0
         for rid in rids:
             entry = None
@@ -546,59 +621,86 @@ class RouterState:
             self._release(rid)
             return 0
         path, body, headers = request_body_from_payload(rid, payload)
-        affinity = payload.get("cache_hint") or payload.get("tenant") or None
-        tried = set(exclude or ())
-        attempts = max(3, len(self.workers) + 1)
-        last_detail = "no routable worker"
-        for attempt in range(attempts):
-            if deadline_unix is not None and time.time() >= deadline_unix:
-                last_detail = "deadline expired during failover"
-                break
-            w = self.pick(affinity, exclude=tried)
-            if w is None:
-                time.sleep(min(0.2, self.probe_interval_s))
-                continue
-            with self._lock:
-                self._assigned[rid] = w.name
-                w.inflight += 1
-                w.requests += 1
+        # cross-process trace context on the replay hop, same as the
+        # inline proxy's
+        headers["X-Parent-Span"] = f"router:{rid}"
+        mode = "handoff_replay" if source is not None else "journal_replay"
+        # the POST-failover half of the stitched fleet trace: a NEW
+        # router-side trace under the SAME base trace id as the original
+        # dispatch, so the merged /debug/trace shows both halves of a
+        # handed-off request inside one process group
+        trace = (self.obs.start_request(rid.partition("#")[0])
+                 if self.obs is not None else None)
+        outcome = "error"
+        try:
+            affinity = (payload.get("cache_hint") or payload.get("tenant")
+                        or None)
+            tried = set(exclude or ())
+            attempts = max(3, len(self.workers) + 1)
+            last_detail = "no routable worker"
+            for attempt in range(attempts):
+                if (deadline_unix is not None
+                        and time.time() >= deadline_unix):
+                    last_detail = "deadline expired during failover"
+                    break
+                w = self.pick(affinity, exclude=tried)
+                if w is None:
+                    time.sleep(min(0.2, self.probe_interval_s))
+                    continue
+                with self._lock:
+                    self._assigned[rid] = w.name
+                    w.inflight += 1
+                    w.requests += 1
+                    if source is not None:
+                        source.failovers += 1
                 if source is not None:
-                    source.failovers += 1
-            if source is not None:
-                source = None  # count the failover once, not per attempt
-            self.journal.start(rid)
-            try:
-                status, resp = self._worker_http(
-                    w, "POST", path, body=body, headers=headers,
-                    timeout=self.proxy_timeout_s,
-                )
-            # lint-allow[swallowed-exception]: resolved by the retry loop — the next attempt picks a survivor, and exhaustion terminal-izes the rid as failover:exhausted below
-            except OSError as e:
+                    source = None  # count the failover once, not per attempt
+                self.recorder.record(mode, rid=rid, worker=w.name)
+                self.journal.start(rid)
+                t_req = time.monotonic()
+                try:
+                    status, resp = self._worker_http(
+                        w, "POST", path, body=body, headers=headers,
+                        timeout=self.proxy_timeout_s,
+                    )
+                # lint-allow[swallowed-exception]: resolved by the retry loop — the next attempt picks a survivor, and exhaustion terminal-izes the rid as failover:exhausted below
+                except OSError as e:
+                    if trace is not None:
+                        trace.add(mode, t_req, time.monotonic() - t_req,
+                                  worker=w.name, outcome="unreachable")
+                    with self._lock:
+                        w.inflight -= 1
+                    tried.add(w.name)
+                    last_detail = f"{w.name}: {e}"
+                    continue
+                if trace is not None:
+                    trace.add(mode, t_req, time.monotonic() - t_req,
+                              worker=w.name, status=status)
                 with self._lock:
                     w.inflight -= 1
-                tried.add(w.name)
-                last_detail = f"{w.name}: {e}"
-                continue
-            with self._lock:
-                w.inflight -= 1
-            if status == 200:
-                self._journal_success(rid, path, resp)
+                if status == 200:
+                    self._journal_success(rid, path, resp)
+                    self._release(rid)
+                    outcome = "ok"
+                    return 1
+                if status in (429, 503):
+                    # a typed worker shed: back off and retry a (possibly
+                    # different) survivor until attempts run out
+                    tried = set(exclude or ())
+                    last_detail = f"{w.name}: shed {status}"
+                    time.sleep(min(0.2, self.probe_interval_s))
+                    continue
+                detail = (json.dumps(resp)[:200] if resp
+                          else f"http {status}")
+                self.journal.fail(rid, f"failover:http_{status}", detail)
                 self._release(rid)
-                return 1
-            if status in (429, 503):
-                # a typed worker shed: back off and retry a (possibly
-                # different) survivor until attempts run out
-                tried = set(exclude or ())
-                last_detail = f"{w.name}: shed {status}"
-                time.sleep(min(0.2, self.probe_interval_s))
-                continue
-            detail = json.dumps(resp)[:200] if resp else f"http {status}"
-            self.journal.fail(rid, f"failover:http_{status}", detail)
+                return 0
+            self.journal.fail(rid, "failover:exhausted", last_detail)
             self._release(rid)
             return 0
-        self.journal.fail(rid, "failover:exhausted", last_detail)
-        self._release(rid)
-        return 0
+        finally:
+            if self.obs is not None:
+                self.obs.finish_request(trace, outcome)
 
     def _journal_success(self, rid: str, path: str, resp: dict | None) -> None:
         """Fold a worker 200 into the ledger for ONE single-prompt
@@ -795,6 +897,31 @@ class RouterState:
             }
         if self.journal is not None:
             payload["journal"] = self.journal.stats_dict()
+        # per-worker operator summary (outside the router lock — the
+        # federation sample table carries its own leaf lock): the at-a-
+        # glance block an operator reads before anything else. Fields come
+        # from the worker's own snapshot when federation has one; the
+        # probe-loop view covers the rest
+        fed = self.federation
+        for r in payload["workers"]:
+            s = fed.sample(r["name"]) if fed is not None else None
+            p = s.payload if s is not None else None
+            wd = (p.get("watchdog") or {}) if p else {}
+            r["summary"] = {
+                "ready": bool(p.get("ready")) if p else r["up"],
+                "readyz": p.get("readyz_reason") if p else r["reason"],
+                "rung": p.get("degraded_rung", 0) if p else None,
+                "inflight": r["inflight"],
+                "watchdog_max_heartbeat_age_s": wd.get(
+                    "max_heartbeat_age_s"
+                ),
+                "last_markdown_reason": r["last_markdown_reason"],
+                "sample_age_s": (round(s.age_s(), 3)
+                                 if s is not None else None),
+            }
+        if fed is not None:
+            payload["federation"] = fed.stats_dict()
+        payload["incidents"] = self.incidents.counts_snapshot()
         if not payload["workers_up"]:
             payload["status"] = "degraded"
         return payload
@@ -808,6 +935,7 @@ class RouterState:
         with self._lock:
             rows = [w.row() for w in self.workers]
             sheds = dict(self._sheds)
+        reg = self.worker_labels
         lines: list[str] = []
 
         def meta(name: str) -> None:
@@ -832,9 +960,14 @@ class RouterState:
             meta(metric)
             for r in rows:
                 name = r["name"]
-                # lint-allow[metric-label-cardinality]: the worker label set is the fleet roster — operator-declared at startup, bounded by --spawn-workers/--workers
-                lines.append(f'{_PREFIX}{metric}{{worker="{name}"}} '
-                             f'{r[key]}')
+                # worker= values pass through the bounded roster registry:
+                # canonical() collapses anything off-roster into "other",
+                # which is what the metric-label-cardinality rule checks
+                lines.append(
+                    f'{_PREFIX}{metric}'
+                    f'{{worker="{reg.canonical(name, touch=False)}"}}'
+                    f" {r[key]}"
+                )
         meta("router_sheds_total")
         for reason in _SHED_REASONS:
             lines.append(
@@ -854,6 +987,18 @@ class RouterState:
             simple("journal_replay_seconds_total",
                    js.get("replay_seconds", 0.0))
             simple("journal_pending", js.get("pending", 0))
+        # fleet federation rollups + per-worker gauges (the scrape loop's
+        # re-export) and the incident counter, by typed trigger reason
+        if self.federation is not None:
+            lines.extend(self.federation.metrics_lines(reg))
+        inc = self.incidents.counts_snapshot()
+        meta("fleet_incidents_total")
+        for reason in ("slo_fast_burn", "markdown", "failover",
+                       "operator"):
+            lines.append(
+                f'{_PREFIX}fleet_incidents_total{{reason="{reason}"}} '
+                f"{inc.get(reason, 0)}"
+            )
         return "\n".join(lines) + "\n"
 
 
@@ -866,6 +1011,7 @@ def make_router_handler(state: RouterState):
         MAX_BODY_BYTES = 16 * 1024 * 1024
 
         _rid: str | None = None
+        _trace_status: str = "ok"
 
         # -- plumbing (same response contract as serve/server.py) ---------
 
@@ -964,10 +1110,47 @@ def make_router_handler(state: RouterState):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif path == "/debug/trace":
+                self._debug_trace()
+            elif path == "/debug/slo":
+                if state.federation is None:
+                    self._json({"error": "federation disabled "
+                                         "(--no-federation)"}, 404)
+                else:
+                    self._json(state.federation.fleet_slo())
+            elif path == "/v1/usage":
+                if state.federation is None:
+                    self._json({"error": "federation disabled "
+                                         "(--no-federation)"}, 404)
+                else:
+                    self._json(state.federation.fleet_usage())
+            elif path == "/debug/flightrecorder":
+                # the routing-decision ring: the router's half of every
+                # incident bundle, readable without minting one
+                self._json(state.recorder.snapshot())
             elif path.startswith("/v1/requests/"):
                 self._request_status(path[len("/v1/requests/"):])
             else:
                 self._json({"error": f"unknown path {path}"}, 404)
+
+        def _debug_trace(self) -> None:
+            """ONE merged Chrome trace for the whole fleet: a fresh
+            federation sweep pulls every worker's span ring (and its
+            clock offset from the scrape RTT midpoint), the router's own
+            proxy spans join as the reference-clock group, and traces
+            sharing an id — including the pre-/post-failover halves of a
+            handed-off request — land in one Perfetto process."""
+            from ..obs.export import merged_chrome_trace, trace_state_payload
+
+            groups = [{
+                "source": "router",
+                "clock_offset_s": 0.0,
+                "traces": trace_state_payload(state.obs.snapshot()[0]),
+            }]
+            if state.federation is not None:
+                state.federation.scrape_all()
+                groups.extend(state.federation.trace_groups())
+            self._json(merged_chrome_trace(groups))
 
         def do_POST(self) -> None:  # noqa: N802 (stdlib API)
             self._rid = None
@@ -1023,10 +1206,18 @@ def make_router_handler(state: RouterState):
                 self._shed(shed_reason,
                            503 if shed_reason == "shutdown" else 429)
                 return
+            # root of the stitched fleet trace: the router's own span ring
+            # records the proxy hop(s); workers nest under it via the
+            # X-Parent-Span header the dispatch forwards
+            trace = (state.obs.start_request(self._rid)
+                     if state.obs is not None else None)
+            self._trace_status = "ok"
             try:
-                self._dispatch(path, req, tenant, tier)
+                self._dispatch(path, req, tenant, tier, trace)
             finally:
                 state.release_admission()
+                if state.obs is not None:
+                    state.obs.finish_request(trace, self._trace_status)
 
         def _journal_accepts(self, path: str, req: dict, tenant: str,
                              tier: str) -> list[str]:
@@ -1075,8 +1266,12 @@ def make_router_handler(state: RouterState):
             return [state.journal.accept(r) for r in reqs]
 
         def _dispatch(self, path: str, req: dict, tenant: str,
-                      tier: str) -> None:
+                      tier: str, trace=None) -> None:
+            t_acc = time.monotonic()
             rids = self._journal_accepts(path, req, tenant, tier)
+            if trace is not None:
+                trace.add("journal_accept", t_acc,
+                          time.monotonic() - t_acc, rids=len(rids))
             affinity = (
                 req.get("cache_hint")
                 or next((h for h in (req.get("cache_hints") or [])
@@ -1084,7 +1279,8 @@ def make_router_handler(state: RouterState):
                 or tenant or None
             )
             body = {**req, "request_id": self._rid}
-            fwd_headers = {"X-Request-Id": self._rid}
+            fwd_headers = {"X-Request-Id": self._rid,
+                           "X-Parent-Span": f"router:{self._rid}"}
             if tenant:
                 fwd_headers["X-Tenant"] = tenant
             tried: set[str] = set()
@@ -1105,11 +1301,15 @@ def make_router_handler(state: RouterState):
                         state.journal.fail(rid, "shed:no_worker",
                                            "no routable worker")
                         state._release(rid)
+                    self._trace_status = "shed"
                     self._shed("no_worker", 503)
                     return
                 state.assign(rids, w)
+                state.recorder.record("route", rid=self._rid,
+                                      worker=w.name, path=path)
                 for rid in rids:
                     state.journal.start(rid) if state.journal else None
+                t_req = time.monotonic()
                 try:
                     status, resp = state._worker_http(
                         w, "POST", path, body=body, headers=fwd_headers,
@@ -1124,6 +1324,13 @@ def make_router_handler(state: RouterState):
                     # the claim and must keep retrying, not mistake our
                     # own claim for a concurrent handoff and orphan the
                     # rids non-terminal
+                    if trace is not None:
+                        # the PRE-failover half: this span and the
+                        # re-dispatch onto a survivor share one trace id,
+                        # which is what joins them in the merged trace
+                        trace.add("proxy", t_req,
+                                  time.monotonic() - t_req,
+                                  worker=w.name, outcome="failover")
                     already = False
                     with state._lock:
                         w.inflight -= 1
@@ -1137,9 +1344,16 @@ def make_router_handler(state: RouterState):
                                 claimed_by_me = True
                         if not already:
                             w.failovers += len(rids) or 1
+                    state.recorder.record("failover", rid=self._rid,
+                                          worker=w.name,
+                                          error=str(e)[:120])
+                    state.incidents.trigger(
+                        "failover", detail=f"{w.name}: {e}"
+                    )
                     if already:
                         # a probe-loop handoff owns these rids; the result
                         # lands in the ledger — point the client at it
+                        self._trace_status = "failover_in_progress"
                         self._json(
                             {"error": "failover_in_progress",
                              "detail": f"poll /v1/requests/{self._rid}"},
@@ -1150,12 +1364,18 @@ def make_router_handler(state: RouterState):
                     logger.warning("proxy to %s failed (%s) — inline "
                                    "failover", w.name, e)
                     continue
+                if trace is not None:
+                    trace.add("proxy", t_req, time.monotonic() - t_req,
+                              worker=w.name, status=status)
+                if status != 200:
+                    self._trace_status = f"http_{status}"
                 self._settle(path, rids, w, status, resp)
                 return
             for rid in rids:
                 state.journal.fail(rid, "failover:exhausted",
                                    "inline retries exhausted")
                 state._release(rid)
+            self._trace_status = "failover_exhausted"
             self._shed("no_worker", 503)
 
         def _settle(self, path: str, rids: list[str], w: Worker,
@@ -1340,6 +1560,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--no-probe-slo-burn", action="store_true",
                    help="ignore worker SLO burn verdicts in the mark-down "
                         "hysteresis")
+    p.add_argument("--federation-interval-ms", type=float, default=1000.0,
+                   help="fleet federation scrape cadence (worker "
+                        "/debug/obs/snapshot JSON); rollups re-export on "
+                        "the router /metrics as vnsum_serve_fleet_*")
+    p.add_argument("--no-federation", action="store_true",
+                   help="disable the federation scrape loop: no fleet "
+                        "rollups, fleet /debug/slo and /v1/usage answer "
+                        "404, /debug/trace carries router spans only")
+    p.add_argument("--incident-dir", default=None,
+                   help="incident bundle directory (default: "
+                        "<fleet-dir>/incidents when --fleet-dir is set); "
+                        "unset without --fleet-dir = incident capture off")
+    p.add_argument("--incident-min-interval-s", type=float, default=30.0,
+                   help="per-trigger-reason incident capture throttle")
     p.add_argument("--drain-timeout-s", type=float, default=30.0)
     args = p.parse_args(argv)
 
@@ -1371,6 +1605,8 @@ def main(argv: list[str] | None = None) -> int:
             workers.append(Worker(h.name, h.host, h.port, handle=h))
         if args.journal_dir is None:
             args.journal_dir = str(fleet_dir / "router")
+        if args.incident_dir is None:
+            args.incident_dir = str(fleet_dir / "incidents")
     else:
         for i, ep in enumerate(
             s.strip() for s in args.workers.split(",") if s.strip()
@@ -1396,6 +1632,10 @@ def main(argv: list[str] | None = None) -> int:
         tenants=tenants,
         restart_crashed=not args.no_restart_crashed,
         probe_slo_burn=not args.no_probe_slo_burn,
+        federate=not args.no_federation,
+        federation_interval_s=args.federation_interval_ms / 1000.0,
+        incident_dir=args.incident_dir,
+        incident_min_interval_s=args.incident_min_interval_s,
     )
     state.start()
     server = make_router_server(state, args.host, args.port)
@@ -1409,6 +1649,19 @@ def main(argv: list[str] | None = None) -> int:
 
     signal.signal(signal.SIGTERM, _graceful)
     signal.signal(signal.SIGINT, _graceful)
+
+    def _operator_incident(signum, frame) -> None:
+        # operator-triggered correlated capture: mint an incident and fan
+        # the dump out off the signal frame (capture does worker HTTP)
+        threading.Thread(
+            target=state.incidents.trigger,
+            kwargs={"reason": "operator", "detail": "SIGUSR1",
+                    "sync": True},
+            name="operator-incident", daemon=True,
+        ).start()
+
+    if hasattr(signal, "SIGUSR1"):
+        signal.signal(signal.SIGUSR1, _operator_incident)
     try:
         server.serve_forever()
     finally:

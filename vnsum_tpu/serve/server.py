@@ -90,6 +90,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -473,6 +474,82 @@ class ServeState:
                 return False, "brownout"
         return True, "ready"
 
+    def obs_snapshot(self) -> dict:
+        """``GET /debug/obs/snapshot`` — the federation scrape payload:
+        everything the fleet router folds into its rollups in ONE JSON
+        round trip (no Prometheus text parsing on the hot scrape path).
+        ``mono_now`` is this process's monotonic clock at snapshot time —
+        the router pairs it with its own send/receive stamps to estimate
+        the per-worker clock offset (RTT midpoint) that aligns worker
+        spans into the merged fleet trace."""
+        from ..obs.export import trace_state_payload
+
+        ready, reason = self.readiness()
+        payload: dict = {
+            "mono_now": time.monotonic(),
+            "ready": ready,
+            "readyz_reason": reason,
+            "queue_depth": self.scheduler.queue.depth,
+            **self.metrics.federation_snapshot(),
+        }
+        if self.supervisor is not None:
+            payload["degraded_rung"] = int(self.supervisor.rung)
+        if self.slo is not None:
+            slo = self.slo.evaluate()
+            objectives = slo.get("objectives", {})
+            payload["slo"] = {
+                "breached": bool(slo.get("breached")),
+                "burn_fast_max": max(
+                    (o["burn_fast"] for o in objectives.values()),
+                    default=0.0,
+                ),
+                "objectives": {
+                    name: {k: o[k] for k in ("kind", "compliance",
+                                             "burn_fast", "burn_slow",
+                                             "budget_remaining",
+                                             "breaching")}
+                    for name, o in objectives.items()
+                },
+            }
+        usage = self.metrics.usage_snapshot(self.metrics.usage_window_s)
+        if usage is not None:
+            payload["usage"] = usage
+            payload["usage_window_s"] = self.metrics.usage_window_s
+        if self.watchdog is not None:
+            ages = self.watchdog.stats_dict().get("heartbeat_ages", {})
+            payload["watchdog"] = {
+                "max_heartbeat_age_s": max(ages.values(), default=0.0),
+                "heartbeat_ages": ages,
+            }
+        if self.obs is not None:
+            payload["traces"] = trace_state_payload(self.obs.snapshot()[0])
+        return payload
+
+    def incident_dump(self, incident: str) -> dict:
+        """``POST /debug/dump?incident=<id>`` — this worker's contribution
+        to a router-minted incident bundle: the flight-recorder ring, a
+        stack snapshot, and the clock stamp that lets the report CLI order
+        this process's events against the others'. The ring additionally
+        dumps to the worker's own --flight-dir (throttled, tagged with the
+        incident id) so the evidence survives even if the router dies
+        mid-collection."""
+        from .watchdog import snapshot_stacks
+
+        payload: dict = {
+            "incident": incident,
+            "mono_now": time.monotonic(),
+            "wall_now": time.time(),
+            "stacks": snapshot_stacks(),
+        }
+        if self.recorder is not None:
+            payload["flightrecorder"] = self.recorder.snapshot()
+            dump_path = self.recorder.dump(f"incident_{incident}")
+            if dump_path is not None:
+                payload["dump_path"] = str(dump_path)
+        if self.watchdog is not None:
+            payload["watchdog"] = self.watchdog.health_dict()
+        return payload
+
     def cancel_request(self, rid: str) -> dict | None:
         """``DELETE /v1/requests/<id>`` — gang-cancel ``rid`` and its
         ``rid#N`` fan-out children everywhere in the lifecycle. Returns the
@@ -706,6 +783,11 @@ def make_handler(state: ServeState):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif path == "/debug/obs/snapshot":
+                # the federation scrape surface: counters + raw histogram
+                # state + slo/usage/readyz/watchdog views + raw request
+                # spans, one JSON document (serve/federation.py)
+                self._json(state.obs_snapshot())
             elif path == "/debug/slo":
                 if state.slo is None:
                     self._json({"error": "no SLOs configured (--slo unset)"},
@@ -760,6 +842,11 @@ def make_handler(state: ServeState):
                     "uptime_s": round(
                         time.monotonic() - state.started_monotonic, 3
                     ),
+                    # this process's monotonic clock at render time: the
+                    # fleet router reads it against its own probe send/
+                    # receive stamps (RTT midpoint) to estimate the clock
+                    # offset the merged /debug/trace corrects by
+                    "mono_now": time.monotonic(),
                     "queue_depth": state.scheduler.queue.depth,
                     "queued_tokens": state.scheduler.queue.queued_tokens,
                     "closed": state.scheduler.closed,
@@ -1186,11 +1273,27 @@ def make_handler(state: ServeState):
 
         def do_POST(self) -> None:  # noqa: N802 (stdlib API)
             self._rid = None  # keep-alive: one handler serves many requests
-            path = self.path.partition("?")[0]
+            path, _, query = self.path.partition("?")
             if path == "/v1/generate":
                 self._generate()
             elif path == "/v1/summarize":
                 self._summarize()
+            elif path == "/debug/dump":
+                # correlated incident capture: the router fans this out to
+                # every worker with a minted incident id; the response IS
+                # this worker's bundle contribution (ring + stacks + clock)
+                import urllib.parse
+
+                raw = urllib.parse.parse_qs(query).get(
+                    "incident", ["manual"]
+                )[0]
+                incident = re.sub(r"[^A-Za-z0-9_.-]", "_", raw)[:64] or \
+                    "manual"
+                # drain the (typically empty) body so keep-alive survives
+                length = int(self.headers.get("Content-Length") or 0)
+                if length > 0:
+                    self.rfile.read(min(length, self.MAX_BODY_BYTES))
+                self._json(state.incident_dump(incident))
             else:
                 self._json({"error": "not found"}, 404)
 
@@ -1291,7 +1394,8 @@ def make_handler(state: ServeState):
             # one RequestTrace for the whole HTTP request: multi-prompt
             # calls put each prompt's spans on its own sub-track
             trace = (
-                state.obs.start_request(self._rid)
+                state.obs.start_request(
+                    self._rid, parent=self.headers.get("X-Parent-Span"))
                 if state.obs is not None else None
             )
             try:
@@ -1374,7 +1478,8 @@ def make_handler(state: ServeState):
                 self._resume_stream()
                 return
             trace = (
-                state.obs.start_request(self._rid)
+                state.obs.start_request(
+                    self._rid, parent=self.headers.get("X-Parent-Span"))
                 if state.obs is not None else None
             )
             channel = StreamChannel(
@@ -1500,7 +1605,8 @@ def make_handler(state: ServeState):
             # the trace survives every strategy round: all the request's
             # fanned-out prompts record onto it through the QueuedBackend
             trace = (
-                state.obs.start_request(self._rid)
+                state.obs.start_request(
+                    self._rid, parent=self.headers.get("X-Parent-Span"))
                 if state.obs is not None else None
             )
             qbackend = state.scheduler.backend_view(
