@@ -460,6 +460,184 @@ def inflight_phase(args) -> dict:
     }
 
 
+def fused_phase(args) -> dict:
+    """Fused multi-step decode trade study (--fused-segments N, swept over
+    {1, 2, 4, 8}). Two loads per N, because the win and the cost live in
+    different regimes:
+
+    - SOLO arm (1 closed-loop client, long decodes): decode tokens per
+      engine-second PER SLOT. At batch 1 there are no join dynamics at
+      all, so the measurement isolates exactly what fusing buys: one host
+      round-trip (and one per-dispatch overhead) now covers up to N
+      on-device segments instead of one. This is the small-batch regime
+      kernel looping targets, and the one a TPU serving stack sits in
+      whenever traffic is thin.
+    - MIXED arm (--fused-clients clients, 1:1 short/long): anchored TTFT
+      and goodput. Joins, cancel/preempt polls, and stream deltas coarsen
+      to one opportunity per fused dispatch, so a joiner waits up to N
+      segment times for admission — and coarser join cadence desyncs rows
+      so they lose batch-level step overlap with residents (rows decoding
+      together share a step's cost; rows decoding alone pay it alone).
+      The mixed arm reports that convoy cost per N instead of hiding it.
+    - byte-identity probe per N (on the solo arm, unloaded): each
+      distinct prompt's reply must equal the offline FakeBackend
+      reference. The fused loop runs the SAME per-row update as N=1 —
+      only host round-trip cadence changes — so any divergence is a
+      correctness bug, not a tuning artifact.
+
+    The exit guard first filters N>1 arms whose mixed-load TTFT p50
+    regression (vs N=1) stays within --fused-max-ttft-pct, then picks the
+    highest solo tokens ratio among them — which must clear
+    --fused-min-tokens-ratio. Byte-identity must hold at every swept N,
+    winner or not."""
+    sweep = (1, 2, 4, 8)
+    short = "tin ngan gon sau day chi tam tu"                     # 8 words
+    long_ = "phan tich chuyen sau ve tinh hinh kinh te xa hoi " * 6  # 54
+    distinct = [short, long_]
+    reference = [FakeBackend().generate([p])[0] for p in distinct]
+    deadline_s = args.deadline_s
+
+    def mixed_payload(cid, i):
+        return {
+            "prompt": short if (cid + i) % 2 else long_,
+            "deadline_ms": deadline_s * 1000,
+        }
+
+    def long_payload(cid, i):
+        return {"prompt": long_, "deadline_ms": deadline_s * 1000}
+
+    def make_state(n):
+        backend = FakeBackend(
+            batch_overhead_s=args.inflight_prefill_s,
+            per_step_s=args.per_step_s,
+            # finer segments than the r04 arm (default 4 vs 8): short
+            # segments are what you WANT for join/cancel latency, and
+            # they are exactly where per-dispatch overhead hurts most —
+            # the regime fused decode exists to fix
+            segment_words=args.fused_segment_words,
+            segment_overhead_s=args.segment_overhead_s,
+            per_slot_segment_s=args.per_slot_segment_s,
+        )
+        return ServeState(
+            backend,
+            max_batch=args.max_batch,
+            max_wait_s=args.max_wait_ms / 1000.0,
+            max_queue_depth=64,
+            trace_sample=1.0,
+            trace_ring=64,
+            inflight=True,
+            slots=args.fused_slots,
+            fused_segments=n,
+        )
+
+    def run_arm(n, clients, per_client, payload_fn, probe_identity):
+        state = make_state(n)
+        server = make_server(state, "127.0.0.1", 0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        byte_identical = None
+        if probe_identity:
+            # unloaded, before the measured window — determinism is the
+            # claim, not a race
+            probe = Client(base)
+            replies = []
+            for prompt in distinct:
+                status, body = probe.post("/v1/generate", {"prompt": prompt})
+                replies.append(
+                    json.loads(body)["completions"][0]["text"]
+                    if status == 200 else f"<http {status}>"
+                )
+            probe.close()
+            byte_identical = replies == reference
+        loop = closed_loop(base, clients, per_client, deadline_s, payload_fn)
+        server.shutdown()
+        server.server_close()
+        hists = state.scheduler.metrics.histograms_snapshot()
+        snap = state.scheduler.metrics.snapshot()
+        state.close()
+        arm = {
+            **loop,
+            "fused_segments": n,
+            "ttft_p50_s": hists["ttft_seconds"]["p50"],
+            "ttft_p99_s": hists["ttft_seconds"]["p99"],
+            "segments": snap.segments,
+            "fused_dispatches": snap.fused_dispatches,
+            "segments_per_dispatch": (
+                round(snap.segments / snap.fused_dispatches, 2)
+                if snap.fused_dispatches else 0.0
+            ),
+            "engine_seconds": round(snap.engine_seconds, 3),
+            "generated_tokens": snap.generated_tokens,
+            "decode_tokens_per_engine_s_per_slot": (
+                round(
+                    snap.generated_tokens / snap.engine_seconds
+                    / args.fused_slots, 2,
+                )
+                if snap.engine_seconds else 0.0
+            ),
+        }
+        if byte_identical is not None:
+            arm["byte_identical"] = byte_identical
+        return arm
+
+    solo, mixed = {}, {}
+    for n in sweep:
+        solo[f"n{n}"] = run_arm(
+            n, 1, args.per_client, long_payload, probe_identity=True
+        )
+        mixed[f"n{n}"] = run_arm(
+            n, args.fused_clients, args.per_client, mixed_payload,
+            probe_identity=False,
+        )
+
+    solo_base = solo["n1"]["decode_tokens_per_engine_s_per_slot"]
+    ttft_base = mixed["n1"]["ttft_p50_s"]
+    for n in sweep:
+        s, m = solo[f"n{n}"], mixed[f"n{n}"]
+        s["tokens_ratio_vs_n1"] = (
+            round(s["decode_tokens_per_engine_s_per_slot"] / solo_base, 3)
+            if solo_base else 0.0
+        )
+        m["ttft_p50_regression_pct"] = (
+            round((m["ttft_p50_s"] - ttft_base) / ttft_base * 100.0, 1)
+            if ttft_base else 0.0
+        )
+    eligible = [
+        n for n in sweep
+        if n > 1
+        and mixed[f"n{n}"]["ttft_p50_regression_pct"] <= args.fused_max_ttft_pct
+    ]
+    best_n = (
+        max(eligible, key=lambda n: solo[f"n{n}"]["tokens_ratio_vs_n1"])
+        if eligible else 0
+    )
+    return {
+        "workload": {
+            "solo": f"1 closed-loop client x {args.per_client} long "
+                    "requests (batch-1 decode: pure dispatch "
+                    "amortization, no join dynamics)",
+            "mixed": f"{args.fused_clients} closed-loop clients x "
+                     f"{args.per_client} requests, 1:1 short/long over "
+                     f"{args.fused_slots} slots (join coarsening and "
+                     "step-overlap loss land here)",
+            "segment_words": args.fused_segment_words,
+        },
+        "sweep": list(sweep),
+        "solo": solo,
+        "mixed": mixed,
+        "best_n": best_n,
+        "best_tokens_ratio": (
+            solo[f"n{best_n}"]["tokens_ratio_vs_n1"] if best_n else 0.0
+        ),
+        "best_ttft_p50_regression_pct": (
+            mixed[f"n{best_n}"]["ttft_p50_regression_pct"] if best_n else 0.0
+        ),
+        "byte_identical_all_n": all(
+            solo[f"n{n}"]["byte_identical"] for n in sweep
+        ),
+    }
+
+
 def sharded_phase(args) -> dict:
     """DP-replica goodput scaling (ISSUE 11 tentpole): the r04 mixed
     short/long workload against the in-flight server at 1 vs 2 data
@@ -1716,6 +1894,26 @@ def main(argv=None) -> int:
     p.add_argument("--segment-words", type=int, default=8)
     p.add_argument("--segment-overhead-s", type=float, default=0.002)
     p.add_argument("--per-slot-segment-s", type=float, default=0.0005)
+    p.add_argument("--fused-clients", type=int, default=4,
+                   help="closed-loop clients for the fused sweep — small "
+                        "on purpose: at low occupancy per-dispatch "
+                        "overhead dominates and fusing has the most to "
+                        "amortize")
+    p.add_argument("--fused-slots", type=int, default=4)
+    p.add_argument("--fused-segment-words", type=int, default=4,
+                   help="segment granularity for the fused sweep — finer "
+                        "than the r04 arm because short segments (good "
+                        "join/cancel latency) maximize per-dispatch "
+                        "overhead, the cost fused decode amortizes")
+    p.add_argument("--fused-min-tokens-ratio", type=float, default=1.05,
+                   help="exit non-zero unless the best N>1 fused arm "
+                        "beats N=1 decode tokens/s-per-slot by this "
+                        "ratio")
+    p.add_argument("--fused-max-ttft-pct", type=float, default=150.0,
+                   help="exit non-zero when the best fused arm's anchored "
+                        "TTFT p50 regresses vs N=1 by more than this "
+                        "percentage (joins coarsen to fused-dispatch "
+                        "cadence; the regression must stay bounded)")
     p.add_argument("--inflight-min-ttft-gain", type=float, default=25.0,
                    help="exit non-zero when the in-flight arm's anchored "
                         "TTFT p50 improves less than this percentage")
@@ -1808,7 +2006,7 @@ def main(argv=None) -> int:
                         "affinity-off arm (near-parity is expected on the "
                         "homogeneous workload; this is a no-regression "
                         "guard, not a win claim)")
-    p.add_argument("--out", default="BENCH_serving_r13.json")
+    p.add_argument("--out", default="BENCH_serving_r14.json")
     p.add_argument("--min-speedup", type=float, default=4.0,
                    help="exit non-zero below this goodput ratio (CI smoke "
                         "passes a softer floor: shared 2-core runners get "
@@ -1926,6 +2124,11 @@ def main(argv=None) -> int:
     print("in-flight phase ...", flush=True)
     inflight = inflight_phase(args)
 
+    # 6b) fused multi-step decode: N-segment dispatch sweep (TTFT/goodput
+    # trade study at small batch)
+    print("fused phase ...", flush=True)
+    fused = fused_phase(args)
+
     # 7) durable serving: write-ahead journal on/off overhead
     print("journal phase ...", flush=True)
     journal = journal_phase(args)
@@ -1999,6 +2202,7 @@ def main(argv=None) -> int:
         },
         "shared_prefix": shared_prefix,
         "inflight": inflight,
+        "fused": fused,
         "journal": journal,
         "sharded": sharded,
         "fleet": fleet,
@@ -2044,6 +2248,21 @@ def main(argv=None) -> int:
         f"x{inflight['goodput_ratio']}, {inflight['inflight']['refills']} "
         f"refills over {inflight['inflight']['segments']} segments"
     )
+    best_solo = fused["solo"][f"n{fused['best_n']}"] if fused["best_n"] else None
+    if best_solo:
+        print(
+            f"fused: best N={fused['best_n']} at "
+            f"x{fused['best_tokens_ratio']} solo decode tokens/s-per-slot "
+            f"vs N=1 ({best_solo['decode_tokens_per_engine_s_per_slot']} vs "
+            f"{fused['solo']['n1']['decode_tokens_per_engine_s_per_slot']}; "
+            f"{best_solo['segments_per_dispatch']} segments/dispatch), "
+            f"mixed TTFT p50 regression "
+            f"{fused['best_ttft_p50_regression_pct']}%, "
+            f"byte_identical_all_n={fused['byte_identical_all_n']}"
+        )
+    else:
+        print("fused: NO eligible N>1 arm (every mixed-load TTFT p50 "
+              "regression exceeded --fused-max-ttft-pct)")
     print(
         f"journal overhead: {journal['journal_overhead_pct']}% "
         f"({journal['journal_on']['goodput_rps']} vs "
@@ -2122,6 +2341,17 @@ def main(argv=None) -> int:
         # claims to: anchored TTFT and goodput under identical load
         and inflight["ttft_p50_improvement_pct"] >= args.inflight_min_ttft_gain
         and inflight["goodput_ratio"] >= args.inflight_min_goodput
+        # fused multi-step decode: the best N>1 arm must buy decode
+        # throughput per slot at small batch with a BOUNDED anchored-TTFT
+        # regression, outputs byte-identical at EVERY swept N, and the
+        # fused arms must actually have fused (segments > dispatches)
+        and fused["best_n"] > 0
+        and fused["best_tokens_ratio"] >= args.fused_min_tokens_ratio
+        and fused["best_ttft_p50_regression_pct"] <= args.fused_max_ttft_pct
+        and fused["byte_identical_all_n"]
+        and all(fused["solo"][f"n{n}"]["segments"]
+                > fused["solo"][f"n{n}"]["fused_dispatches"]
+                for n in fused["sweep"] if n > 1)
         # durability tax stays inside the acceptance bar
         and journal["journal_overhead_pct"] <= args.journal_max_overhead_pct
         # multi-chip serving: 2 DP replicas must actually scale goodput

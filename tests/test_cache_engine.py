@@ -247,6 +247,15 @@ def test_eviction_never_corrupts_under_concurrent_traffic():
         t.start()
     for t in threads:
         t.join()
+    # deterministic churn for the eviction assert: the concurrent phase
+    # CAN legally evict nothing if every dispatch lands as a full batch
+    # (all three headers' chains matched and pinned at insert time, so
+    # insertion skips rather than evicts). With the pool full of resident
+    # headers and nothing pinned anymore, a fresh prefix MUST evict.
+    fresh = "tieu de moi hoan toan khac biet chua tung thay " * 2
+    prompt = fresh + "phan duoi cung rieng biet"
+    got = sched.submit(prompt, cache_hint=fresh).result(timeout=10)
+    assert got.text == oracle.generate([prompt])[0]
     sched.close()
     assert not errors
     st = fb.prefix_cache_stats()

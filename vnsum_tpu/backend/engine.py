@@ -892,7 +892,8 @@ class TpuBackend:
             )
         return jax.jit(slot_prefill)
 
-    def _make_slot_segment_fn(self, B: int, S: int, max_new: int, gen):
+    def _make_slot_segment_fn(self, B: int, S: int, max_new: int, gen,
+                              fused_segments: int = 1):
         """One in-flight decode segment: advance every live slot by up to
         ``segment_tokens`` tokens with PER-ROW step counters — the refill
         path's defining requirement is that slots at different generation
@@ -901,7 +902,14 @@ class TpuBackend:
         spec-verify machinery (verify_attention_mask + vector write_index,
         num_q=1). For any single row the emitted-token math is exactly
         decode_part's, so greedy outputs match the one-shot path with the
-        same caveat class as compaction (batch-shape tiling last bits)."""
+        same caveat class as compaction (batch-shape tiling last bits).
+
+        ``fused_segments`` fuses N host boundaries into ONE dispatch
+        (Kernel Looping, arXiv 2410.23668): the same while_loop simply runs
+        to ``segment_tokens * N`` with the on-device all-rows-done stop
+        unchanged — per-row math is identical to N back-to-back dispatches,
+        so greedy outputs are byte-identical to N=1 by construction; only
+        the host's join/poll cadence coarsens."""
         cfg = self.cfg
         C = S + max_new
         eos, vocab_limit, restrict = self._sampling_setup(gen)
@@ -912,7 +920,7 @@ class TpuBackend:
         use_kernel = use_flash_decode and self.mesh is None
         interpret = self.interpret
         layer_window = self._layer_window_fn()
-        seg = self.segment_tokens
+        seg = self.segment_tokens * max(int(fused_segments), 1)
 
         def segment(params, t, cur, cache, done, uids, out, pads, seed):
             base = jax.random.key(seed)
@@ -1013,6 +1021,7 @@ class TpuBackend:
         max_new_tokens: int | None = None,
         config: GenerationConfig | None = None,
         prompt_tokens: int = 0,
+        fused_segments: int = 1,
     ):
         """Open a persistent in-flight serving loop: a fixed-shape decode
         batch of ``slots`` rows where finished rows are harvested at every
@@ -1026,7 +1035,10 @@ class TpuBackend:
         fixes the prompt bucket S (0 = the full context minus the decode
         budget); prompts that don't fit are rejected at admit for the
         caller to route through the one-shot path, which remains
-        generate()'s default."""
+        generate()'s default. ``fused_segments`` fuses N decode segments
+        into one dispatch with async host polling (see TpuSlotLoop.step) —
+        joins/cancels/preemption coarsen to the fused cadence while greedy
+        outputs stay byte-identical to N=1."""
         from .inflight import TpuSlotLoop
 
         n_slots = slots or self.batch_size
@@ -1064,11 +1076,12 @@ class TpuBackend:
             )
         return TpuSlotLoop(
             self, n_slots, S, max_new, gen, seed=self._next_seed(gen),
+            fused_segments=fused_segments,
         )
 
     def _get_seg_fn(self, kind: str, B: int, S: int, max_new: int, gen,
-                    resume_from: int = 0):
-        key = (kind, B, S, max_new, gen.with_(seed=0), resume_from)
+                    resume_from: int = 0, fused: int = 1):
+        key = (kind, B, S, max_new, gen.with_(seed=0), resume_from, fused)
         if key not in self._seg_fns:
             t0 = time.time()
             if kind == "prefill":
@@ -1076,7 +1089,7 @@ class TpuBackend:
             elif kind == "slot_prefill":
                 fn = self._make_slot_prefill_fn(B, S, max_new, gen, resume_from)
             elif kind == "slot_seg":
-                fn = self._make_slot_segment_fn(B, S, max_new, gen)
+                fn = self._make_slot_segment_fn(B, S, max_new, gen, fused)
             elif kind == "adopt":
                 fn = self._make_adopt_fn(B)
             else:

@@ -77,12 +77,14 @@ class SlotCompletion:
 
 @dataclass
 class SegmentResult:
-    """One decode segment's outcome."""
+    """One decode dispatch's outcome (up to ``fused_segments`` on-device
+    segment boundaries per dispatch — N=1 is the classic one-segment step)."""
 
     completions: list = field(default_factory=list)
-    live: int = 0               # rows live at segment start
-    new_tokens: int = 0         # tokens retired across all rows this segment
+    live: int = 0               # rows live at dispatch start
+    new_tokens: int = 0         # tokens retired across all rows this dispatch
     seconds: float = 0.0
+    device_segments: int = 1    # segments the fused dispatch actually ran
 
 
 @dataclass
@@ -115,7 +117,7 @@ class TpuSlotLoop:
     """
 
     def __init__(self, backend, slots: int, S: int, max_new: int, gen,
-                 seed: int) -> None:
+                 seed: int, fused_segments: int = 1) -> None:
         import jax.numpy as jnp
 
         self.backend = backend
@@ -124,6 +126,10 @@ class TpuSlotLoop:
         self.max_new = int(max_new)
         self.gen = gen
         self.seed = seed
+        # fused multi-step decode (Kernel Looping, arXiv 2410.23668): one
+        # dispatch covers up to N on-device segment boundaries, and the
+        # host polls array readiness instead of blocking per segment
+        self.fused_segments = max(int(fused_segments), 1)
         b = backend
         B = self.slots
         # resident device state: every slot starts FREE (all-pad, done)
@@ -158,8 +164,13 @@ class TpuSlotLoop:
         self._admissions: dict[int, SlotAdmission] = {}
         self._t_host = np.zeros((B,), np.int64)
         self._uid_next = 0
-        self.segments = 0
+        self.segments = 0           # on-device segments retired
+        self.fused_dispatches = 0   # host dispatches (== segments at N=1)
         self.refills = 0
+        # boundary out-buffer snapshot: when step(fetch_outputs=True) rode
+        # the control fetch, partial_outputs serves from it instead of
+        # paying a second d2h per boundary (None = no snapshot resident)
+        self._out_snap = None
         self._closed = False
 
     # -- introspection ---------------------------------------------------
@@ -303,6 +314,8 @@ class TpuSlotLoop:
                 for m in matches.values():
                     pc.release(m)
 
+        # the adopt scatter rewrote out rows: any boundary snapshot is stale
+        self._out_snap = None
         skipped = resume[2] if resume else [0] * len(take)
         admissions: list[SlotAdmission] = []
         occupancy = self.active + len(take)
@@ -338,11 +351,33 @@ class TpuSlotLoop:
 
     # -- one decode segment ----------------------------------------------
 
+    @staticmethod
+    def _await_retirement(arrays) -> None:
+        """Async host polling: request the d2h copies up front (non-
+        blocking), then poll ``jax.Array`` readiness with a backing-off
+        sleep until the fused dispatch retires. The host never blocks
+        inside the runtime while the device is still looping — the poll
+        is pure host time, and the later explicit ``device_get`` finds the
+        copies already landed. ``is_ready``/``copy_to_host_async`` perform
+        no implicit transfer, so the transfer-guard sanitizer stays green
+        on this path."""
+        for a in arrays:
+            a.copy_to_host_async()
+        spin = 0.0001
+        while not all(a.is_ready() for a in arrays):
+            time.sleep(spin)
+            spin = min(spin * 2, 0.005)
+
     # hot path
     def step(self) -> SegmentResult:
-        """Advance every live slot by up to ``segment_tokens`` tokens, then
-        harvest finished rows at the boundary. The done/t fetch IS the
-        segment boundary — the same control sync the continuous path pays."""
+        """Advance every live slot by up to ``segment_tokens *
+        fused_segments`` tokens in ONE dispatch (the on-device while_loop
+        owns the early all-rows-done stop), then harvest finished rows at
+        the boundary. The host does not block per segment: it dispatches
+        the fused program, polls array readiness asynchronously, and pays
+        ONE coalesced done/t/out fetch when the dispatch retires. The out
+        snapshot it leaves behind serves ``partial_outputs`` — a streaming
+        boundary costs one d2h, not two."""
         if self._closed:
             raise RuntimeError("slot loop is closed")
         res = SegmentResult(live=self.active)
@@ -355,9 +390,11 @@ class TpuSlotLoop:
         b = self.backend
         tracing = current_collector() is not None
         seg_fn = b._get_seg_fn(
-            "slot_seg", self.slots, self.S, self.max_new, self.gen
+            "slot_seg", self.slots, self.S, self.max_new, self.gen,
+            fused=self.fused_segments,
         )
         t0 = time.monotonic()
+        self._out_snap = None
         with hot_path_transfer_guard():
             # lint-allow[host-sync-in-hot-path]: host list -> host array for the uids argument, no device sync
             uids_np = np.asarray(self._uids, np.int32)
@@ -366,26 +403,41 @@ class TpuSlotLoop:
                 b.params, self._t, self._cur, self._cache, self._done,
                 uids_np, self._out, self._pads, self.seed,
             )
-            # ONE explicit fetch for both control values, exactly like the
-            # continuous path's segment boundary
-            # lint-allow[host-sync-in-hot-path]: segment-boundary done/t fetch is the loop's control dependency
-            done_h, t_h = jax.device_get((self._done, self._t))
+            # whether a row finished is unknowable before the done poll, so
+            # the out buffer ALWAYS rides the boundary fetch — one coalesced
+            # d2h covers harvest AND streaming instead of the former
+            # fetch-done-then-maybe-fetch-out / fetch-out-again-per-stream
+            # pattern (a [B, max_new] int32 block, small next to a segment's
+            # compute)
+            ctrl = (self._done, self._t, self._out)
+            self._await_retirement(ctrl)
+            # ONE explicit fetch for the whole boundary: control values and
+            # the output buffer together (the copies already landed — this
+            # resolves them without a fresh device sync)
+            # lint-allow[host-sync-in-hot-path]: segment-boundary done/t/out fetch is the loop's control dependency, already resident host-side via the async copies
+            done_h, t_h, out_h = jax.device_get(ctrl)
             finished = [
                 s for s, k in enumerate(self._keys)
                 if k is not None and done_h[s]
             ]
-            out_h = None
-            if finished:
-                # lint-allow[host-sync-in-hot-path]: harvesting finished rows' tokens before their slots are refilled
-                out_h = jax.device_get(self._out)
         res.seconds = time.monotonic() - t0
-        res.new_tokens = int(
-            sum(int(t_h[s]) - int(self._t_host[s])
-                for s, k in enumerate(self._keys) if k is not None)
+        deltas = [
+            int(t_h[s]) - int(self._t_host[s])
+            for s, k in enumerate(self._keys) if k is not None
+        ]
+        res.new_tokens = int(sum(deltas))
+        # how many on-device segment boundaries the fused dispatch crossed:
+        # the deepest row's advance, in segment_tokens units (early-stopped
+        # dispatches report fewer than fused_segments)
+        seg_tokens = max(int(b.segment_tokens), 1)
+        res.device_segments = min(
+            max(-(-max(deltas, default=0) // seg_tokens), 1),
+            self.fused_segments,
         )
         for s, k in enumerate(self._keys):
             if k is not None:
                 self._t_host[s] = int(t_h[s])
+        self._out_snap = out_h
         for s in finished:
             text = b._detok(out_h[s], tuple(self.gen.eos_ids))
             res.completions.append(SlotCompletion(
@@ -395,10 +447,12 @@ class TpuSlotLoop:
             self._keys[s] = None
             self._prompts[s] = None
             self._admissions.pop(s, None)
-        self.segments += 1
+        self.segments += res.device_segments
+        self.fused_dispatches += 1
         if tracing:
             emit("decode_seg", t0, res.seconds, B=self.slots, S=self.S,
-                 live=res.live, refill=True)
+                 live=res.live, refill=True,
+                 fused=res.device_segments)
         return res
 
     # -- preemption / streaming (serve/qos.py + serve/stream.py) ---------
@@ -444,12 +498,13 @@ class TpuSlotLoop:
     def partial_outputs(self, keys) -> dict:
         """Decoded-so-far text per resident key, keyed by ``id(key)`` (keys
         are arbitrary caller objects, not necessarily hashable) — the
-        streaming harvest. One explicit fetch of the output buffer per call
-        (the caller invokes it once per segment, only when streaming
-        residents exist); rows are cut at their host-tracked cursor so
-        unwritten tail slots never leak into a delta."""
-        import jax
-
+        streaming harvest. Served from the boundary SNAPSHOT step() left
+        behind (the out buffer rode the coalesced done/t/out fetch), so a
+        streaming boundary pays zero extra d2h; rows are cut at their
+        host-tracked cursor so unwritten tail slots never leak into a
+        delta. The device fetch below is the cold fallback only — a caller
+        polling between an admit and the next step, where the snapshot was
+        invalidated by the adopt scatter."""
         targets = {id(k) for k in keys}
         rows = [
             s for s, k in enumerate(self._keys)
@@ -457,8 +512,12 @@ class TpuSlotLoop:
         ]
         if not rows:
             return {}
-        # lint-allow[host-sync-in-hot-path]: the streaming harvest IS a host fetch by definition — one coalesced out-buffer read per segment, gated on streaming residents existing
-        out_h = jax.device_get(self._out)
+        out_h = self._out_snap
+        if out_h is None:
+            import jax
+
+            # lint-allow[host-sync-in-hot-path]: cold fallback off the boundary cadence (post-admit, pre-step); the hot path serves the coalesced snapshot above
+            out_h = jax.device_get(self._out)
         eos = tuple(self.gen.eos_ids)
         return {
             id(self._keys[s]): self.backend._detok(
@@ -479,3 +538,4 @@ class TpuSlotLoop:
         # HBM tenant, and a replacement loop allocates its own
         self._cache = None
         self._cur = self._done = self._t = self._out = self._pads = None
+        self._out_snap = None

@@ -160,6 +160,9 @@ class ServingStats:
     # segment boundary (0 for the batch-dispatch scheduler)
     segments: int = 0
     refills: int = 0
+    # fused multi-step decode: host dispatches of the slot loop (each
+    # covers up to --fused-segments on-device segments; == segments at N=1)
+    fused_dispatches: int = 0
     # fault tolerance (serve/supervisor.py): classified dispatch failures,
     # retries scheduled, bisection splits, requests quarantined as poison,
     # total backoff slept, and degradation-ladder transitions

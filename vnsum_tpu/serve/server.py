@@ -129,6 +129,7 @@ class ServeState:
         inflight: bool = False,
         slots: int | None = None,
         slot_prompt_tokens: int = 0,
+        fused_segments: int = 1,
         supervisor=None,
         supervise: bool = True,
         journal_dir: str | None = None,
@@ -296,7 +297,8 @@ class ServeState:
 
             self.scheduler = InflightScheduler(
                 backend, slots=slots,
-                slot_prompt_tokens=slot_prompt_tokens, **common,
+                slot_prompt_tokens=slot_prompt_tokens,
+                fused_segments=fused_segments, **common,
             )
         else:
             self.scheduler = MicroBatchScheduler(backend, **common)
@@ -1845,6 +1847,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--slot-prompt-tokens", type=int, default=0,
                    help="in-flight prompt bucket S; longer prompts fall "
                         "back to one-shot dispatch (0 = full context)")
+    p.add_argument("--fused-segments", type=int, default=1,
+                   help="fused multi-step decode: on-device segments per "
+                        "slot-loop dispatch (the host polls asynchronously "
+                        "and joins/cancels/streams at the fused cadence; "
+                        "N>1 amortizes the dispatch/sync tax at small "
+                        "batch, trading TTFT/poll latency bounded by N — "
+                        "greedy outputs identical at every N)")
     p.add_argument("--max-queue", type=int, default=256,
                    help="admission control: max queued requests")
     p.add_argument("--max-queued-tokens", type=int, default=0,
@@ -2005,6 +2014,11 @@ def main(argv: list[str] | None = None) -> int:
                         "so disconnect cancels land mid-decode)")
     args = p.parse_args(argv)
 
+    if args.fused_segments < 1:
+        p.error(f"--fused-segments {args.fused_segments} must be >= 1")
+    if args.fused_segments > 1 and not args.inflight:
+        p.error("--fused-segments > 1 requires --inflight (it is the slot "
+                "loop's dispatch-fusing knob)")
     cache_blocks = 0 if args.no_prefix_cache else args.cache_blocks
     mesh = None
     if args.mesh:
@@ -2104,6 +2118,7 @@ def main(argv: list[str] | None = None) -> int:
         inflight=args.inflight,
         slots=args.slots,
         slot_prompt_tokens=args.slot_prompt_tokens,
+        fused_segments=args.fused_segments,
         journal_dir=args.journal_dir,
         journal_fsync_s=args.journal_fsync_ms / 1000.0,
         mesh=mesh,
